@@ -1,0 +1,159 @@
+"""IR-level autodiff: append gradient ops to a Program.
+
+TPU-native equivalent of the reference's source-to-source backward pass
+(reference: python/paddle/fluid/backward.py:425 append_backward, :117
+_addup_repetitive_outputs_, :167 no-grad pruning). Gradients are *ops in the
+IR*, not a jax.grad closure — the program stays the product, serializable and
+inspectable; JAX only executes it. Each forward op's grad ops come from the
+registry's grad makers (generic vjp-backed by default, registry.py).
+
+Fan-in accumulation: when several consumers contribute to one variable's
+gradient, later contributions are renamed and summed eagerly (pairwise `sum`
+ops), which is semantically the reference's @RENAME@ + sum_op insertion.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .framework.desc import OpDesc
+from .framework.framework import (Block, Parameter, Program, Variable,
+                                  grad_var_name)
+from .ops import registry
+
+__all__ = ["append_backward", "calc_gradient"]
+
+
+def _collect_no_grad(block: Block, extra: Optional[Set[str]]) -> Set[str]:
+    no_grad = set(extra or ())
+    for name, v in block.vars.items():
+        if getattr(v, "stop_gradient", False) or v.desc.stop_gradient:
+            no_grad.add(name)
+    return no_grad
+
+
+def _relevant_op_indices(block: Block, loss_name: str) -> List[int]:
+    """Backward slice: ops that (transitively) produce the loss."""
+    target = {loss_name}
+    idxs = []
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if target & set(op.output_arg_names):
+            idxs.append(i)
+            target |= set(op.input_arg_names)
+    idxs.reverse()
+    return idxs
+
+
+def _ensure_grad_var(block: Block, gname: str):
+    """Declare a grad var mirroring its forward var's shape/dtype."""
+    if block.has_var(gname):
+        return
+    base = gname
+    for marker in ("@RENAME@",):
+        if marker in base:
+            base = base.split(marker)[0]
+    if base.endswith("@GRAD"):
+        base = base[: -len("@GRAD")]
+    if block.has_var_recursive(base):
+        fv = block.var_recursive(base)
+        block.create_var(name=gname, shape=fv.desc.shape, dtype=fv.dtype,
+                         lod_level=fv.lod_level)
+    else:
+        block.create_var(name=gname)
+
+
+def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
+                    no_grad_set: Optional[Set[str]] = None,
+                    callbacks=None) -> List[Tuple[Parameter, Variable]]:
+    """Append grad ops for `loss` and return [(param, grad_var)].
+
+    Only root-block autodiff is supported directly; control-flow ops carry
+    their own sub-block grad logic via custom grad makers.
+    """
+    program = loss.block.program
+    block = program.global_block()
+    assert loss.block.idx == 0, "loss must live in the root block"
+    no_grad = _collect_no_grad(block, no_grad_set)
+
+    rel = _relevant_op_indices(block, loss.name)
+
+    # Seed: d loss / d loss = 1
+    loss_g = grad_var_name(loss.name)
+    _ensure_grad_var(block, loss_g)
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": [loss_g]},
+        attrs={"shape": list(loss.shape or [1]), "value": 1.0,
+               "dtype": loss.dtype})
+
+    produced_count: Dict[str, int] = {loss_g: 1}
+    grad_to_var: Dict[str, str] = {loss_g: loss.name}
+
+    for i in reversed(rel):
+        fwd_op = block.ops[i]
+        gdescs = registry.make_grad_op_descs(fwd_op.desc, no_grad)
+        for g in gdescs:
+            # Rename duplicate grad writes, then accumulate with sum ops.
+            renames: List[Tuple[str, str]] = []
+            for slot, names in list(g.outputs.items()):
+                new_names = []
+                for n in names:
+                    c = produced_count.get(n, 0)
+                    if c == 0:
+                        produced_count[n] = 1
+                        new_names.append(n)
+                    else:
+                        rn = f"{n}@RENAME@{c}"
+                        produced_count[n] = c + 1
+                        new_names.append(rn)
+                        renames.append((n, rn))
+                g.outputs[slot] = new_names
+
+            for slot, names in g.outputs.items():
+                for n in names:
+                    _ensure_grad_var(block, n)
+                    base = n.split("@RENAME@")[0]
+                    if base.endswith("@GRAD"):
+                        grad_to_var[base] = base[: -len("@GRAD")]
+            block.desc.ops.append(g)
+            from .framework.framework import Operator
+            op_obj = Operator(block, g)
+            block.ops.append(op_obj)
+            program._version += 1
+            block._infer_shape(op_obj)
+
+            for orig, rn in renames:
+                block.append_op(type="sum", inputs={"X": [orig, rn]},
+                                outputs={"Out": [orig]})
+
+    program.grad_info_map.update(grad_to_var)
+
+    if parameter_list is not None:
+        params = [block.var(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = block.all_parameters()
+    result = []
+    for p in params:
+        if not getattr(p, "trainable", True):
+            continue
+        gname = grad_var_name(p.name)
+        if produced_count.get(gname):
+            result.append((p, block.var(gname)))
+    return result
+
+
+def calc_gradient(targets, inputs, target_gradients=None,
+                  no_grad_set: Optional[Set[str]] = None):
+    """Gradients of `targets` w.r.t. `inputs` (reference backward.py:555)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    assert len(targets) == 1, "calc_gradient supports a single target for now"
+    append_backward(targets[0], no_grad_set=no_grad_set)
+    block = targets[0].block.program.global_block()
+    outs = []
+    for v in inputs:
+        gname = grad_var_name(v.name)
+        outs.append(block.var(gname) if block.has_var(gname) else None)
+    return outs

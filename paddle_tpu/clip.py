@@ -1,0 +1,120 @@
+"""Error clip + gradient clipping pipeline
+(reference: python/paddle/fluid/clip.py:32-215)."""
+
+from __future__ import annotations
+
+__all__ = ["ErrorClipByValue", "GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "set_gradient_clip",
+           "append_gradient_clip_ops", "error_clip_callback"]
+
+
+class BaseErrorClipAttr:
+    def append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+    def append_clip_op(self, block, grad_name):
+        block.append_op(type="clip", inputs={"X": [grad_name]},
+                        outputs={"Out": [grad_name]},
+                        attrs={"min": self.min, "max": self.max})
+
+
+def error_clip_callback(block, op):
+    # placeholder hook for per-op error clipping; attrs-driven clipping is
+    # attached via Variable error_clip attrs (reference clip.py:66)
+    pass
+
+
+class BaseGradientClipAttr:
+    def process_context(self, context, param, grad):
+        pass
+
+    def create_operators(self, param, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+    def create_operators(self, param, grad):
+        from .layers.nn import clip as clip_layer
+        return param, clip_layer(grad, self.min, self.max)
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def create_operators(self, param, grad):
+        from .layers.nn import clip_by_norm
+        return param, clip_by_norm(grad, self.clip_norm)
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+    def process_context(self, context, param, grad):
+        if self.group_name not in context:
+            context[self.group_name] = []
+            context[self.group_name + "_clip_value"] = self.clip_norm
+        from .layers.nn import reduce_sum
+        from .layers.ops import square
+        context[self.group_name].append(reduce_sum(square(grad)))
+
+    def create_operators(self, param, grad):
+        from .layers import nn, ops, tensor
+        context = getattr(self, "_context")
+        # compute the global norm + scale once per group, reuse for every param
+        scale_key = self.group_name + "_scale_var"
+        if scale_key not in context:
+            group = context[self.group_name]
+            total = group[0] if len(group) == 1 else tensor.sums(group)
+            global_norm = ops.sqrt(total)
+            clip_value = tensor.fill_constant([1], "float32", self.clip_norm)
+            context[scale_key] = nn.elementwise_div(
+                clip_value, nn.elementwise_max(clip_value, global_norm))
+        return param, nn.elementwise_mul(grad, context[scale_key])
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    from .framework.framework import default_main_program
+    program = program or default_main_program()
+    if param_list is None:
+        param_list = program.global_block().all_parameters()
+    param_list = [program.global_block().var(p) if isinstance(p, str) else p
+                  for p in param_list]
+    for param in param_list:
+        param.gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(param_grad):
+    context = {}
+    for p, g in param_grad:
+        clip_attr = getattr(p, "gradient_clip_attr", None)
+        if clip_attr is None:
+            clip_attr = NullGradientClipAttr()
+        clip_attr.process_context(context=context, param=p, grad=g)
+    res = []
+    for p, g in param_grad:
+        clip_attr = getattr(p, "gradient_clip_attr", None)
+        if clip_attr is None:
+            clip_attr = NullGradientClipAttr()
+        clip_attr._context = context
+        res.append(clip_attr.create_operators(param=p, grad=g))
+    return res

@@ -1,0 +1,89 @@
+"""DataFeeder: minibatch rows -> feed dict of arrays/LoDTensors
+(reference: python/paddle/fluid/data_feeder.py:25 DataToLoDTensorConverter,
+:69 DataFeeder)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .executor import LoDTensor
+from .framework.framework import Variable, default_main_program
+
+
+class DataToLoDTensorConverter:
+    def __init__(self, place, lod_level, shape, dtype):
+        self.place = place
+        self.lod_level = lod_level
+        self.shape = [d for d in shape]
+        self.dtype = dtype
+        self.data: List = []
+        self.lod = [[0] for _ in range(lod_level)]
+
+    def feed(self, data):
+        self._feed_impl_(data, self.lod, self.lod_level)
+
+    def _feed_impl_(self, data, lod, lod_level):
+        if lod_level == 0:
+            self.data.append(data)
+        else:
+            lod[0].append(lod[0][-1] + len(data))
+            for each_data in data:
+                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+
+    def done(self):
+        if self.lod_level == 0:
+            shape = [len(self.data)] + [abs(d) for d in self.shape if d != -1] \
+                if -1 in self.shape else [len(self.data)] + list(self.shape)
+            arr = np.array(self.data, dtype=self.dtype)
+            want = [len(self.data)] + [d for d in self.shape if d > 0]
+            if list(arr.shape) != want and int(np.prod(arr.shape)) == int(
+                    np.prod(want)):
+                arr = arr.reshape(want)
+            return arr
+        flat = np.array(self.data, dtype=self.dtype)
+        if flat.ndim == 1:
+            flat = flat.reshape(-1, 1)
+        t = LoDTensor(flat, self.lod)
+        return t
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        program = program or default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError("feed_list entries must be Variables or names")
+            self.feed_dtypes.append(each_var.dtype)
+            self.feed_names.append(each_var.name)
+            shape = list(each_var.shape or [])
+            self.feed_lod_level.append(each_var.lod_level)
+            self.feed_shapes.append(shape)
+        self.place = place
+
+    def feed(self, iterable):
+        converters = []
+        for lod_level, shape, dtype in zip(self.feed_lod_level,
+                                           self.feed_shapes, self.feed_dtypes):
+            batch_free = [d for d in shape if d != -1] if shape and \
+                shape[0] == -1 else shape
+            converters.append(DataToLoDTensorConverter(
+                place=self.place, lod_level=lod_level, shape=batch_free,
+                dtype=dtype))
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                f"sample has {len(each_sample)} fields, expected "
+                f"{len(converters)}")
+            for each_converter, each_slot in zip(converters, each_sample):
+                each_converter.feed(each_slot)
+        ret_dict = {}
+        for each_name, each_converter in zip(self.feed_names, converters):
+            ret_dict[each_name] = each_converter.done()
+        return ret_dict

@@ -1,0 +1,460 @@
+"""Executor: compiles program blocks to XLA and runs them on TPU/CPU.
+
+TPU-native replacement for the reference's interpreting executor
+(reference: paddle/fluid/framework/executor.cc:96-344 Executor::Run/Prepare,
+python/paddle/fluid/executor.py:182-400). The reference walks a block op by
+op, dispatching each to a CUDA kernel against a mutable Scope. On TPU that
+per-op dispatch model wastes the compiler: here `Executor.run` *traces the
+whole block's op lowerings into a single function* — feed vars and persistable
+state in, fetch vars and updated state out — and `jax.jit`s it once per
+(program, feed, fetch) signature. Parameters are donated so optimizer updates
+alias in-place in HBM. An eager mode (`use_jit=False` or
+PADDLE_TPU_EAGER=1) interprets op-by-op like the reference, for debugging and
+NaN/Inf checks (reference FLAGS_check_nan_inf, executor.cc:325-333).
+
+Scope semantics follow the reference (scope.h:38): persistable variables live
+in the global scope across runs; block-local temporaries vanish after the run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.desc import VarType
+from .framework.framework import Program, Variable, default_main_program
+from .ops import registry
+
+__all__ = [
+    "CPUPlace", "TPUPlace", "CUDAPlace", "place_device",
+    "LoDTensor", "Scope", "global_scope", "scope_guard", "Executor",
+]
+
+
+# ---------------------------------------------------------------------------
+# Places (reference: platform/place.h:24,34,53 — CPUPlace/CUDAPlace variant).
+# TPUPlace is the first-class accelerator place; CUDAPlace is accepted for
+# source compatibility and maps to the same accelerator backend.
+# ---------------------------------------------------------------------------
+
+class Place:
+    device_kind = "cpu"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __eq__(self, other):
+        return (type(self) is type(other)) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+
+class CPUPlace(Place):
+    device_kind = "cpu"
+
+
+class TPUPlace(Place):
+    device_kind = "accelerator"
+
+
+class CUDAPlace(TPUPlace):
+    """Source-compat alias: scripts written for fluid.CUDAPlace(0) run on the
+    TPU backend unchanged (BASELINE.json north star)."""
+
+
+def place_device(place: Place):
+    """Resolve a Place to a concrete jax.Device."""
+    if isinstance(place, CPUPlace):
+        cpus = [d for d in jax.devices("cpu")] if "cpu" in {
+            d.platform for d in jax.local_devices()} else None
+        if cpus is None:
+            try:
+                cpus = jax.devices("cpu")
+            except RuntimeError:
+                cpus = jax.local_devices()
+        return cpus[min(place.device_id, len(cpus) - 1)]
+    devs = jax.local_devices()
+    accel = [d for d in devs if d.platform != "cpu"] or devs
+    return accel[min(place.device_id, len(accel) - 1)]
+
+
+# ---------------------------------------------------------------------------
+# Runtime values
+# ---------------------------------------------------------------------------
+
+class LoDTensor:
+    """Runtime tensor + level-of-detail sequence offsets
+    (reference: framework/lod_tensor.h:55,107). The array is padded/dense; the
+    LoD records per-sequence offsets so sequence ops can mask correctly."""
+
+    def __init__(self, array=None, lod: Optional[List[List[int]]] = None):
+        self._array = array
+        self.lod = lod or []
+
+    def set(self, array, place=None):
+        self._array = np.asarray(array)
+
+    def set_lod(self, lod):
+        self.lod = lod
+
+    def array(self):
+        return self._array
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._array)
+        return a.astype(dtype) if dtype is not None else a
+
+    @property
+    def shape(self):
+        return tuple(self._array.shape)
+
+    def recursive_sequence_lengths(self):
+        return [[b - a for a, b in zip(lvl[:-1], lvl[1:])] for lvl in self.lod]
+
+
+class Scope:
+    """name -> runtime value, with parent chain (reference scope.h:38)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self.vars: Dict[str, Any] = {}
+        self.kids: List[Scope] = []
+
+    def new_scope(self) -> "Scope":
+        s = Scope(self)
+        self.kids.append(s)
+        return s
+
+    def var(self, name: str):
+        if name not in self.vars:
+            self.vars[name] = None
+        return self.vars[name]
+
+    def set_var(self, name: str, value):
+        self.vars[name] = value
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return True
+            s = s.parent
+        return False
+
+    def local_var_names(self):
+        return list(self.vars)
+
+    def drop_kids(self):
+        self.kids = []
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# Lowering context handed to op kernels
+# ---------------------------------------------------------------------------
+
+class LoweringContext:
+    def __init__(self, executor: "Executor", program: Program, rng_key,
+                 lod_map: Dict[str, Any]):
+        self.executor = executor
+        self.program = program
+        self.place = executor.place
+        self._rng_key = rng_key
+        self.lod_map = lod_map    # var name -> lod metadata (host-side)
+
+    def next_rng(self, op=None):
+        """Deterministic per-op PRNG key. Keyed on the op's first output name
+        (stable identity), NOT a call counter: the generic vjp grad kernel
+        re-traces the forward lowering, and a counter would hand the re-trace
+        a different key than the forward pass saw (e.g. a dropout mask that
+        differs between forward and backward). Per-step variation comes from
+        the run counter folded into the base key (Executor.run)."""
+        seed = int(op.attr("seed", 0) or 0) if op is not None else 0
+        key = self._rng_key if not seed else jax.random.key(seed)
+        ident = 0
+        if op is not None:
+            outs = op.desc.output_arg_names()
+            if outs:
+                import zlib
+                ident = zlib.crc32(outs[0].encode("utf-8"))
+        return jax.random.fold_in(key, ident)
+
+    def run_block(self, block_idx: int, env: Dict[str, Any]) -> Dict[str, Any]:
+        """Trace a sub-block's ops against `env` (for control-flow lowerings).
+        Mutates and returns env."""
+        block = self.program.block(block_idx)
+        for op in block.ops:
+            self.executor._exec_op(self, op, env)
+        return env
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+_EAGER = os.environ.get("PADDLE_TPU_EAGER", "0") == "1"
+_CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
+
+
+class _CompiledBlock:
+    def __init__(self, fn, state_names, feed_names, fetch_names, program):
+        self.fn = fn
+        self.state_names = state_names
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        # strong ref: the cache key uses id(program), which stays valid only
+        # while the program object is alive
+        self.program = program
+
+
+class Executor:
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place if place is not None else TPUPlace(0)
+        self.device = place_device(self.place)
+        self._cache: Dict[Tuple, _CompiledBlock] = {}
+
+    # --- public API ---------------------------------------------------------
+    def run(self, program: Optional[Program] = None, feed: Optional[Dict] = None,
+            fetch_list: Optional[Sequence] = None, feed_var_name: str = "feed",
+            fetch_var_name: str = "fetch", scope: Optional[Scope] = None,
+            return_numpy: bool = True, use_program_cache: bool = True,
+            use_jit: Optional[bool] = None):
+        program = program if program is not None else default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        scope = scope if scope is not None else global_scope()
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+        jit_mode = (not _EAGER) if use_jit is None else use_jit
+
+        # Normalize feeds: LoDTensor → array (+ lod metadata), numpy asarray.
+        feed_vals, lod_map = {}, {}
+        for name, val in feed.items():
+            if isinstance(val, LoDTensor):
+                lod_map[name] = val.lod
+                val = val.array()
+            feed_vals[name] = np.asarray(val) if not isinstance(
+                val, jax.Array) else val
+
+        block = program.global_block()
+        state_names = self._external_inputs(program, block, set(feed_vals), scope)
+        persist_out = self._persistable_outputs(program, block)
+
+        missing = [n for n in state_names if scope.find_var(n) is None]
+        if missing:
+            raise RuntimeError(
+                f"Variables {missing} are read by the program but absent from "
+                f"the scope — run the startup program first.")
+
+        state_vals = {}
+        for n in state_names:
+            v = scope.find_var(n)
+            if isinstance(v, LoDTensor):
+                lod_map[n] = v.lod
+                v = v.array()
+            state_vals[n] = v
+
+        rng_counter = scope.find_var("__rng_counter__") or 0
+        seed = program.random_seed or 12345
+        rng_key = jax.random.fold_in(jax.random.key(seed), rng_counter)
+        scope.set_var("__rng_counter__", rng_counter + 1)
+
+        if jit_mode:
+            key = (id(program), getattr(program, "_version", 0),
+                   tuple(sorted(feed_vals)), tuple(fetch_names),
+                   tuple(state_names), self.place)
+            compiled = self._cache.get(key) if use_program_cache else None
+            if compiled is None:
+                compiled = self._compile(program, state_names, sorted(feed_vals),
+                                         fetch_names, persist_out, lod_map)
+                if use_program_cache:
+                    self._cache[key] = compiled
+            with jax.default_device(self.device):
+                fetch_vals, new_state = compiled.fn(feed_vals, state_vals, rng_key)
+        else:
+            fetch_vals, new_state = self._run_eager(
+                program, feed_vals, state_vals, fetch_names, persist_out,
+                rng_key, lod_map)
+
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        if return_numpy:
+            fetch_vals = [np.asarray(v) for v in fetch_vals]
+        return fetch_vals
+
+    def close(self):
+        self._cache.clear()
+
+    # --- analysis -----------------------------------------------------------
+    @staticmethod
+    def _block_reads_writes(program, block, reads, writes, produced):
+        for op in block.ops:
+            if op.type in ("feed", "fetch"):
+                continue
+            for name in op.input_arg_names:
+                if name not in produced:
+                    reads.add(name)
+            for a in op.desc.attrs.values():
+                from .framework.desc import BlockRef, BlocksRef
+                sub_idxs = []
+                if isinstance(a, BlockRef):
+                    sub_idxs = [a.idx]
+                elif isinstance(a, BlocksRef):
+                    sub_idxs = a.idxs
+                for si in sub_idxs:
+                    Executor._block_reads_writes(
+                        program, program.block(si), reads, writes, set(produced))
+            for name in op.output_arg_names:
+                produced.add(name)
+                writes.add(name)
+
+    def _external_inputs(self, program, block, fed: set, scope) -> List[str]:
+        """Vars the block reads from the scope: already-present scope vars or
+        declared persistables. Reads of undeclared/absent vars are optional
+        inputs (grad cotangents never produced) and resolve to None."""
+        reads, writes = set(), set()
+        self._block_reads_writes(program, block, reads, writes, set(fed))
+        out = []
+        for n in sorted(reads - fed):
+            if scope.has_var(n) and scope.find_var(n) is not None:
+                out.append(n)
+            else:
+                for b in program.blocks:
+                    if b.desc.has_var(n) and b.desc.var(n).persistable:
+                        out.append(n)
+                        break
+        return out
+
+    def _persistable_outputs(self, program, block) -> List[str]:
+        reads, writes = set(), set()
+        self._block_reads_writes(program, block, reads, writes, set())
+        out = []
+        for n in sorted(writes):
+            for b in program.blocks:
+                if b.desc.has_var(n) and b.desc.var(n).persistable:
+                    out.append(n)
+                    break
+        return out
+
+    # --- execution ----------------------------------------------------------
+    def _exec_op(self, ctx: LoweringContext, op, env: Dict[str, Any]):
+        if op.type in ("feed", "fetch"):
+            return
+        opdef = registry.get(op.type)
+        assert opdef.lower is not None, f"op '{op.type}' has no lowering"
+        ins = {slot: [env.get(n) for n in names]
+               for slot, names in op.desc.inputs.items()}
+        outs = opdef.lower(ctx, op, ins)
+        for slot, names in op.desc.outputs.items():
+            vals = outs.get(slot, [])
+            for name, val in zip(names, vals):
+                if val is not None:
+                    env[name] = val
+
+    def _trace_block(self, program, feed_vals, state_vals, fetch_names,
+                     persist_out, rng_key, lod_map):
+        env: Dict[str, Any] = {}
+        env.update(state_vals)
+        env.update(feed_vals)
+        ctx = LoweringContext(self, program, rng_key, lod_map)
+        block = program.global_block()
+        for op in block.ops:
+            self._exec_op(ctx, op, env)
+        fetch = [env[n] for n in fetch_names]
+        new_state = {n: env[n] for n in persist_out if n in env}
+        # state read but never written flows through unchanged
+        for n in state_vals:
+            if n not in new_state:
+                for b in program.blocks:
+                    if b.desc.has_var(n) and b.desc.var(n).persistable:
+                        new_state[n] = env[n]
+                        break
+        return fetch, new_state
+
+    def _compile(self, program, state_names, feed_names, fetch_names,
+                 persist_out, lod_map) -> _CompiledBlock:
+        def fn(feed_vals, state_vals, rng_key):
+            return self._trace_block(program, feed_vals, state_vals,
+                                     fetch_names, persist_out, rng_key, lod_map)
+
+        mesh = getattr(program, "_mesh", None)
+        if mesh is not None:
+            # SPMD: feeds sharded along batch over the 'dp' axis, state
+            # (parameters/accumulators) replicated. XLA GSPMD inserts the
+            # gradient AllReduce over ICI — the TPU-native replacement for
+            # the reference's pserver/NCCL paths (SURVEY.md §2.5).
+            from jax.sharding import NamedSharding, PartitionSpec
+            repl = NamedSharding(mesh, PartitionSpec())
+            dp = mesh.axis_names[0]
+
+            jitted = jax.jit(
+                fn, donate_argnums=(1,),
+                in_shardings=(
+                    {n: NamedSharding(
+                        mesh, PartitionSpec(dp)) for n in feed_names},
+                    {n: repl for n in state_names},
+                    repl))
+        else:
+            jitted = jax.jit(fn, donate_argnums=(1,))
+        return _CompiledBlock(jitted, state_names, feed_names, fetch_names,
+                              program)
+
+    def _run_eager(self, program, feed_vals, state_vals, fetch_names,
+                   persist_out, rng_key, lod_map):
+        env: Dict[str, Any] = {}
+        env.update({k: jnp.asarray(v) for k, v in state_vals.items()})
+        env.update({k: jnp.asarray(v) for k, v in feed_vals.items()})
+        ctx = LoweringContext(self, program, rng_key, lod_map)
+        block = program.global_block()
+        for op in block.ops:
+            self._exec_op(ctx, op, env)
+            if _CHECK_NAN_INF:
+                for name in op.output_arg_names:
+                    v = env.get(name)
+                    if v is not None and jnp.issubdtype(
+                            jnp.asarray(v).dtype, jnp.inexact):
+                        if not bool(jnp.all(jnp.isfinite(v))):
+                            raise FloatingPointError(
+                                f"NaN/Inf in output '{name}' of op {op.type}")
+        fetch = [env[n] for n in fetch_names]
+        new_state = {}
+        for n in set(persist_out) | set(state_vals):
+            if n in env:
+                for b in program.blocks:
+                    if b.desc.has_var(n) and b.desc.var(n).persistable:
+                        new_state[n] = env[n]
+                        break
+        return fetch, new_state

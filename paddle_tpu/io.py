@@ -1,0 +1,171 @@
+"""Checkpoint + inference-model save/load
+(reference: python/paddle/fluid/io.py:66 save_vars, :145 save_persistables,
+:234 load_persistables, :298 save_inference_model, :383 load_inference_model;
+serialization of each tensor mirrors save_op.cc/load_op.cc but uses .npy —
+the on-disk format is ours to define for the TPU framework).
+
+Model directory layout matches the reference: one file per variable named by
+the variable, plus `__model__` holding the serialized program."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .executor import Executor, LoDTensor, Scope, global_scope
+from .framework.framework import (Parameter, Program, Variable,
+                                  default_main_program, default_startup_program)
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "get_inference_program",
+]
+
+
+def _is_persistable(var: Variable) -> bool:
+    return var.persistable
+
+
+def _is_parameter(var: Variable) -> bool:
+    return isinstance(var, Parameter)
+
+
+def save_vars(executor: Executor, dirname: str, main_program: Optional[Program]
+              = None, vars: Optional[Sequence[Variable]] = None,
+              predicate=None, save_file_name: Optional[str] = None):
+    """Write scope values of selected vars to `dirname` (reference io.py:66).
+    The executor argument is kept for API parity; values come from the
+    global scope."""
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    combine = {}
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        lod = None
+        if isinstance(val, LoDTensor):
+            lod, val = val.lod, val.array()
+        arr = np.asarray(val)
+        if save_file_name is None:
+            _save_one(os.path.join(dirname, v.name), arr, lod)
+        else:
+            combine[v.name] = (arr, lod)
+    if save_file_name is not None:
+        with open(os.path.join(dirname, save_file_name), "wb") as f:
+            pickle.dump({k: (np.asarray(a), l) for k, (a, l)
+                         in combine.items()}, f)
+
+
+def _save_one(path: str, arr: np.ndarray, lod):
+    with open(path, "wb") as f:
+        pickle.dump({"tensor": arr, "lod": lod, "version": 0}, f)
+
+
+def _load_one(path: str):
+    with open(path, "rb") as f:
+        d = pickle.load(f)
+    return d["tensor"], d.get("lod")
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_parameter,
+              save_file_name=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_persistable,
+              save_file_name=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              load_file_name: Optional[str] = None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    if load_file_name is not None:
+        with open(os.path.join(dirname, load_file_name), "rb") as f:
+            blob = pickle.load(f)
+        for v in vars:
+            if v.name in blob:
+                arr, lod = blob[v.name]
+                scope.set_var(v.name, LoDTensor(arr, lod) if lod else arr)
+        return
+    for v in vars:
+        path = os.path.join(dirname, v.name)
+        if not os.path.exists(path):
+            continue
+        arr, lod = _load_one(path)
+        scope.set_var(v.name, LoDTensor(arr, lod) if lod else arr)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_parameter,
+              load_file_name=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_persistable,
+              load_file_name=filename)
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or default_main_program()
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    pruned = main_program.prune([], [t.name for t in target_vars])
+    return pruned.clone(for_test=True)
+
+
+def save_inference_model(dirname: str, feeded_var_names: List[str],
+                         target_vars: List[Variable], executor: Executor,
+                         main_program: Optional[Program] = None,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None):
+    """Prune to the inference slice and persist program + params
+    (reference io.py:298)."""
+    main_program = main_program or default_main_program()
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if not isinstance(target_vars, list):
+        target_vars = [target_vars]
+    os.makedirs(dirname, exist_ok=True)
+    pruned = main_program.prune(feeded_var_names,
+                                [t.name for t in target_vars])
+    inference_program = pruned.clone(for_test=True)
+    meta = {
+        "program": inference_program.to_json(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [t.name for t in target_vars],
+    }
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "wb") as f:
+        pickle.dump(meta, f)
+    save_persistables(executor, dirname, inference_program,
+                      filename=params_filename)
+    return inference_program
+
+
+def load_inference_model(dirname: str, executor: Executor,
+                         model_filename: Optional[str] = None,
+                         params_filename: Optional[str] = None):
+    """Returns (program, feed_target_names, fetch_targets)
+    (reference io.py:383)."""
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "rb") as f:
+        meta = pickle.load(f)
+    program = Program.from_json(meta["program"])
+    load_persistables(executor, dirname, program, filename=params_filename)
+    fetch_targets = [program.global_block().var(n)
+                     for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_targets

@@ -1,0 +1,24 @@
+"""Data-input layers (reference: python/paddle/fluid/layers/io.py:29 data)."""
+
+from __future__ import annotations
+
+from ..framework.desc import VarType
+from ..framework.framework import default_main_program, default_startup_program
+
+__all__ = ["data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=VarType.LOD_TENSOR, stop_gradient=True):
+    """Declare a feed variable (reference io.py:29). With append_batch_size,
+    a -1 batch dim is prepended; the executor binds actual shapes at run."""
+    helper_block = default_main_program().current_block()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    var = helper_block.create_var(name=name, shape=shape, dtype=dtype,
+                                  type=type, lod_level=lod_level,
+                                  stop_gradient=stop_gradient)
+    var.desc.stop_gradient = stop_gradient
+    # mirror in startup program so save/load sees consistent descs
+    return var

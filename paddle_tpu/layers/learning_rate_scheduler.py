@@ -1,0 +1,83 @@
+"""LR schedules built as graph ops on a global step counter
+(reference: python/paddle/fluid/layers/learning_rate_scheduler.py:43-180)."""
+
+from __future__ import annotations
+
+import math
+
+from ..layer_helper import LayerHelper
+from . import nn
+from . import ops as _ops
+from . import tensor
+
+__all__ = ["exponential_decay", "natural_exp_decay", "inverse_time_decay",
+           "polynomial_decay", "piecewise_decay", "noam_decay"]
+
+
+def _global_step():
+    return nn.autoincreased_step_counter()
+
+
+def _float_step():
+    return tensor.cast(_global_step(), "float32")
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _float_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = _ops.floor(div)
+    return learning_rate * (decay_rate ** div)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _float_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = _ops.floor(div)
+    return learning_rate * _ops.exp(-1.0 * decay_rate * div)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    step = _float_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = _ops.floor(div)
+    return learning_rate / (1.0 + decay_rate * div)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _float_step()
+    if cycle:
+        div = _ops.ceil(step / float(decay_steps))
+        div = nn.elementwise_max(
+            div, tensor.fill_constant([1], "float32", 1.0))
+        decay_var = div * float(decay_steps)
+        frac = step / decay_var
+    else:
+        frac = nn.elementwise_min(
+            step / float(decay_steps),
+            tensor.fill_constant([1], "float32", 1.0))
+    return (learning_rate - end_learning_rate) * \
+        ((1.0 - frac) ** power) + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    """Piecewise-constant LR: values[i] while step < boundaries[i]."""
+    assert len(values) - len(boundaries) == 1
+    step = _float_step()
+    lr = tensor.fill_constant([1], "float32", values[-1])
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        below = tensor.cast(step < tensor.fill_constant([1], "float32",
+                                                        float(b)), "float32")
+        lr = below * v + (1.0 - below) * lr
+    return lr
+
+
+def noam_decay(d_model, warmup_steps):
+    step = _float_step()
+    a = step ** -0.5
+    b = (warmup_steps ** -1.5) * step
+    return (d_model ** -0.5) * nn.elementwise_min(a, b)

@@ -1,0 +1,666 @@
+"""Neural-network layers DSL (reference: python/paddle/fluid/layers/nn.py —
+fc :81, embedding :188, conv2d :1120, pool2d :1425, batch_norm :1478,
+layer_norm :1567, dropout :846, cross_entropy :892, reduces :2055-2239,
+matmul :2428, softmax_with_cross_entropy :3135, one_hot :3254 …)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..framework.framework import Variable
+from ..initializer import ConstantInitializer, NormalInitializer
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "fc", "embedding", "dropout", "cross_entropy", "square_error_cost",
+    "conv2d", "conv3d", "conv2d_transpose", "pool2d", "batch_norm",
+    "layer_norm", "softmax_with_cross_entropy", "accuracy",
+    "auc", "mean", "mul", "elementwise_add", "elementwise_sub",
+    "elementwise_mul", "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+    "reduce_prod", "matmul", "transpose", "reshape", "split", "topk",
+    "one_hot", "lrn", "l2_normalize", "clip", "clip_by_norm", "scale",
+    "cast", "dropout", "autoincreased_step_counter", "smooth_l1", "log_loss",
+    "label_smooth", "cos_sim", "expand", "squeeze", "unsqueeze", "gather",
+    "scatter", "pad", "nce", "row_conv", "im2sequence", "multiplex",
+    "sigmoid_cross_entropy_with_logits", "maxout",
+]
+
+
+def _simple(op_type, x, attrs=None, extra_inputs=None, out_dtype=None,
+            name=None, outs=("Out",), in_slot="X"):
+    helper = LayerHelper(op_type, name=name)
+    inputs = {in_slot: [x]}
+    if extra_inputs:
+        inputs.update({k: v if isinstance(v, list) else [v]
+                       for k, v in extra_inputs.items() if v is not None})
+    outvars = [helper.create_tmp_variable(dtype=out_dtype or x.dtype)
+               for _ in outs]
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={s: [v] for s, v in zip(outs, outvars)},
+                     attrs=attrs or {})
+    return outvars[0] if len(outvars) == 1 else tuple(outvars)
+
+
+# --- fully connected --------------------------------------------------------
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       use_mkldnn=False, act=None, is_test=False, name=None):
+    """Fully connected layer (reference nn.py:81): out = act(sum_i X_i W_i + b).
+    Lowers to `mul` (MXU matmul) + broadcast add; XLA fuses bias+activation."""
+    helper = LayerHelper("fc", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    mul_results = []
+    for input_var, p_attr in helper.iter_inputs_and_params():
+        input_shape = input_var.shape
+        param_shape = [
+            int(np.prod(input_shape[num_flatten_dims:]))
+        ] + [size]
+        w = helper.create_parameter(attr=p_attr, shape=param_shape,
+                                    dtype=dtype, is_bias=False)
+        tmp = helper.create_tmp_variable(dtype)
+        helper.append_op(type="mul",
+                         inputs={"X": [input_var], "Y": [w]},
+                         outputs={"Out": [tmp]},
+                         attrs={"x_num_col_dims": num_flatten_dims,
+                                "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_tmp_variable(dtype)
+        helper.append_op(type="sum", inputs={"X": mul_results},
+                         outputs={"Out": [pre_bias]})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """Embedding lookup (reference nn.py:188). is_sparse is accepted for
+    source compat; on TPU the grad is a dense scatter-add fused by XLA."""
+    helper = LayerHelper("embedding", param_attr=param_attr)
+    w = helper.create_parameter(attr=helper.param_attr, shape=size,
+                                dtype=dtype, is_bias=False)
+    tmp = helper.create_tmp_variable(dtype)
+    padding_idx = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op(type="lookup_table",
+                     inputs={"W": [w], "Ids": [input]},
+                     outputs={"Out": [tmp]},
+                     attrs={"is_sparse": is_sparse, "padding_idx": padding_idx})
+    return tmp
+
+
+# --- losses -----------------------------------------------------------------
+
+def cross_entropy(input, label, soft_label=False):
+    return _simple("cross_entropy", input,
+                   attrs={"soft_label": soft_label},
+                   extra_inputs={"Label": label}, outs=("Y",))
+
+
+def square_error_cost(input, label):
+    """(input - label)^2, built from elementwise ops (reference nn.py:965)."""
+    helper = LayerHelper("square_error_cost", input=input)
+    minus_out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="elementwise_sub",
+                     inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [minus_out]})
+    square_out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="square", inputs={"X": [minus_out]},
+                     outputs={"Out": [square_out]})
+    return square_out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax = helper.create_tmp_variable(dtype=logits.dtype)
+    loss = helper.create_tmp_variable(dtype=logits.dtype)
+    helper.append_op(type="softmax_with_cross_entropy",
+                     inputs={"Logits": [logits], "Label": [label]},
+                     outputs={"Softmax": [softmax], "Loss": [loss]},
+                     attrs={"soft_label": soft_label})
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label):
+    return _simple("sigmoid_cross_entropy_with_logits", x,
+                   extra_inputs={"Label": label})
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss")
+    diff = helper.create_tmp_variable(dtype=x.dtype)
+    loss = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(type="smooth_l1_loss",
+                     inputs={"X": [x], "Y": [y],
+                             **({"InsideWeight": [inside_weight]}
+                                if inside_weight is not None else {}),
+                             **({"OutsideWeight": [outside_weight]}
+                                if outside_weight is not None else {})},
+                     outputs={"Diff": [diff], "Out": [loss]},
+                     attrs={"sigma": sigma or 1.0})
+    return loss
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper("log_loss", name=name)
+    loss = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="log_loss",
+                     inputs={"Predicted": [input], "Labels": [label]},
+                     outputs={"Loss": [loss]}, attrs={"epsilon": epsilon})
+    return loss
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_tmp_variable(dtype)
+    inputs = {"X": [label]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist]
+    helper.append_op(type="label_smooth", inputs=inputs,
+                     outputs={"Out": [out]}, attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None):
+    """Noise-contrastive estimation loss (reference nce_op.cc, nn.py:2806)."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr)
+    dim = input.shape[1]
+    num_neg_samples = 10 if num_neg_samples is None else num_neg_samples
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype, is_bias=False)
+    b = helper.create_parameter(attr=helper.bias_attr,
+                                shape=[num_total_classes, 1],
+                                dtype=input.dtype, is_bias=True)
+    cost = helper.create_tmp_variable(dtype=input.dtype)
+    sample_logits = helper.create_tmp_variable(dtype=input.dtype)
+    sample_labels = helper.create_tmp_variable(dtype="int64")
+    inputs = {"Input": [input], "Label": [label], "Weight": [w], "Bias": [b]}
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    helper.append_op(type="nce", inputs=inputs,
+                     outputs={"Cost": [cost], "SampleLogits": [sample_logits],
+                              "SampleLabels": [sample_labels]},
+                     attrs={"num_total_classes": num_total_classes,
+                            "num_neg_samples": num_neg_samples})
+    return cost
+
+
+# --- conv / pool ------------------------------------------------------------
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           use_mkldnn=False, act=None, name=None):
+    """2-D convolution, NCHW (reference nn.py:1120). use_cudnn is accepted and
+    ignored: XLA picks the MXU convolution algorithm."""
+    helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = input.dtype
+    groups = groups or 1
+    num_channels = input.shape[1]
+    filter_size = _pair(filter_size)
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+    fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+    std = math.sqrt(2.0 / fan_in)
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op(type="conv2d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [pre_bias]},
+                     attrs={"strides": _pair(stride), "paddings": _pair(padding),
+                            "dilations": _pair(dilation), "groups": groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    def _triple(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+    dtype = input.dtype
+    groups = groups or 1
+    num_channels = input.shape[1]
+    fs = _triple(filter_size)
+    filter_shape = [num_filters, num_channels // groups] + fs
+    fan_in = (num_channels // groups) * fs[0] * fs[1] * fs[2]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=NormalInitializer(0.0, math.sqrt(2.0 / fan_in)))
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op(type="conv3d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [pre_bias]},
+                     attrs={"strides": _triple(stride),
+                            "paddings": _triple(padding),
+                            "dilations": _triple(dilation), "groups": groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, use_cudnn=True, act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    padding_, stride_, dilation_ = _pair(padding), _pair(stride), _pair(dilation)
+    if filter_size is None:
+        assert output_size is not None
+        output_size = _pair(output_size)
+        h, w_ = input.shape[2], input.shape[3]
+        filter_size = [
+            (output_size[0] - (h - 1) * stride_[0] + 2 * padding_[0] - 1)
+            // dilation_[0] + 1,
+            (output_size[1] - (w_ - 1) * stride_[1] + 2 * padding_[1] - 1)
+            // dilation_[1] + 1]
+    else:
+        filter_size = _pair(filter_size)
+    filter_shape = [num_channels, num_filters] + filter_size
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype, is_bias=False)
+    pre_bias = helper.create_tmp_variable(dtype)
+    helper.append_op(type="conv2d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [pre_bias]},
+                     attrs={"strides": stride_, "paddings": padding_,
+                            "dilations": dilation_})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, use_mkldnn=False, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="pool2d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type,
+                            "ksize": _pair(pool_size),
+                            "global_pooling": global_pooling,
+                            "strides": _pair(pool_stride),
+                            "paddings": _pair(pool_padding),
+                            "ceil_mode": ceil_mode})
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(type="maxout", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"groups": groups})
+    return out
+
+
+# --- normalization ----------------------------------------------------------
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, use_mkldnn=False, name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=False):
+    """Batch normalization (reference nn.py:1478, batch_norm_op.cc)."""
+    helper = LayerHelper("batch_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    input_shape = input.shape
+    channel_num = input_shape[1] if data_layout == "NCHW" else input_shape[-1]
+    param_shape = [channel_num]
+    scale = helper.create_parameter(
+        attr=helper.param_attr, shape=param_shape, dtype=dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=param_shape,
+                                   dtype=dtype, is_bias=True)
+    mean = helper.create_parameter(
+        attr=_non_trainable_attr(moving_mean_name),
+        shape=param_shape, dtype=dtype,
+        default_initializer=ConstantInitializer(0.0))
+    variance = helper.create_parameter(
+        attr=_non_trainable_attr(moving_variance_name),
+        shape=param_shape, dtype=dtype,
+        default_initializer=ConstantInitializer(1.0))
+    saved_mean = helper.create_tmp_variable(dtype=dtype, stop_gradient=True)
+    saved_variance = helper.create_tmp_variable(dtype=dtype, stop_gradient=True)
+    out = input if in_place else helper.create_tmp_variable(dtype)
+    helper.append_op(
+        type="batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [variance]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [variance],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_variance]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test})
+    return helper.append_activation(out)
+
+
+def _non_trainable_attr(name):
+    from ..param_attr import ParamAttr
+    return ParamAttr(name=name, trainable=False)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    input_shape = input.shape
+    param_shape = [int(np.prod(input_shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(
+            attr=helper.param_attr, shape=param_shape, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=param_shape,
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    mean_out = helper.create_tmp_variable(dtype=dtype, stop_gradient=True)
+    variance_out = helper.create_tmp_variable(dtype=dtype, stop_gradient=True)
+    out = helper.create_tmp_variable(dtype)
+    helper.append_op(type="layer_norm", inputs=inputs,
+                     outputs={"Y": [out], "Mean": [mean_out],
+                              "Variance": [variance_out]},
+                     attrs={"epsilon": epsilon,
+                            "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(out)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    mid = helper.create_tmp_variable(dtype=input.dtype, stop_gradient=True)
+    helper.append_op(type="lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    norm = helper.create_tmp_variable(dtype=x.dtype, stop_gradient=True)
+    helper.append_op(type="norm", inputs={"X": [x]},
+                     outputs={"Out": [out], "Norm": [norm]},
+                     attrs={"axis": axis, "epsilon": epsilon})
+    return out
+
+
+# --- dropout ----------------------------------------------------------------
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    mask = helper.create_tmp_variable(dtype=x.dtype, stop_gradient=True)
+    helper.append_op(type="dropout", inputs={"X": [x]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "seed": seed if seed is not None else 0})
+    return out
+
+
+# --- metrics ----------------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    topk_out = helper.create_tmp_variable(dtype=input.dtype)
+    topk_indices = helper.create_tmp_variable(dtype="int64")
+    helper.append_op(type="top_k", inputs={"X": [input]},
+                     outputs={"Out": [topk_out], "Indices": [topk_indices]},
+                     attrs={"k": k})
+    acc_out = helper.create_tmp_variable(dtype="float32")
+    correct = correct or helper.create_tmp_variable(dtype="int32")
+    total = total or helper.create_tmp_variable(dtype="int32")
+    helper.append_op(type="accuracy",
+                     inputs={"Out": [topk_out], "Indices": [topk_indices],
+                             "Label": [label]},
+                     outputs={"Accuracy": [acc_out], "Correct": [correct],
+                              "Total": [total]})
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1):
+    helper = LayerHelper("auc")
+    auc_out = helper.create_tmp_variable(dtype="float32")
+    helper.append_op(type="auc",
+                     inputs={"Out": [input], "Label": [label]},
+                     outputs={"AUC": [auc_out]},
+                     attrs={"curve": curve, "num_thresholds": num_thresholds})
+    return auc_out
+
+
+# --- math wrappers ----------------------------------------------------------
+
+def mean(x, name=None):
+    return _simple("mean", x, name=name)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    return _simple("mul", x, attrs={"x_num_col_dims": x_num_col_dims,
+                                    "y_num_col_dims": y_num_col_dims},
+                   extra_inputs={"Y": y}, name=name)
+
+
+def _ew(op_type):
+    def f(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type, act=act, name=name)
+        out = helper.create_tmp_variable(dtype=x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [out]}, attrs={"axis": axis})
+        return helper.append_activation(out)
+    f.__name__ = op_type
+    return f
+
+
+elementwise_add = _ew("elementwise_add")
+elementwise_sub = _ew("elementwise_sub")
+elementwise_mul = _ew("elementwise_mul")
+elementwise_div = _ew("elementwise_div")
+elementwise_max = _ew("elementwise_max")
+elementwise_min = _ew("elementwise_min")
+elementwise_pow = _ew("elementwise_pow")
+
+
+def _reduce(op_type):
+    def f(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_tmp_variable(dtype=input.dtype)
+        if dim is not None and not isinstance(dim, (list, tuple)):
+            dim = [dim]
+        helper.append_op(type=op_type, inputs={"X": [input]},
+                         outputs={"Out": [out]},
+                         attrs={"dim": dim if dim is not None else [0],
+                                "keep_dim": keep_dim,
+                                "reduce_all": dim is None})
+        return out
+    f.__name__ = op_type
+    return f
+
+
+reduce_sum = _reduce("reduce_sum")
+reduce_mean = _reduce("reduce_mean")
+reduce_max = _reduce("reduce_max")
+reduce_min = _reduce("reduce_min")
+reduce_prod = _reduce("reduce_prod")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _simple("matmul", x,
+                   attrs={"transpose_X": transpose_x,
+                          "transpose_Y": transpose_y},
+                   extra_inputs={"Y": y}, name=name)
+
+
+def transpose(x, perm, name=None):
+    return _simple("transpose", x, attrs={"axis": list(perm)}, name=name)
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=True, name=None):
+    helper = LayerHelper("reshape", act=act, name=name)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(type="reshape", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"shape": list(shape)})
+    return helper.append_activation(out)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    input_shape = input.shape
+    dim = dim if dim >= 0 else dim + len(input_shape)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        sections = []
+    else:
+        num = len(num_or_sections)
+        sections = list(num_or_sections)
+    outs = [helper.create_tmp_variable(dtype=input.dtype) for _ in range(num)]
+    helper.append_op(type="split", inputs={"X": [input]},
+                     outputs={"Out": outs},
+                     attrs={"axis": dim, "sections": sections, "num": 0 if sections else num})
+    return outs
+
+
+def topk(input, k):
+    helper = LayerHelper("top_k")
+    values = helper.create_tmp_variable(dtype=input.dtype)
+    indices = helper.create_tmp_variable(dtype="int64")
+    helper.append_op(type="top_k", inputs={"X": [input]},
+                     outputs={"Out": [values], "Indices": [indices]},
+                     attrs={"k": k})
+    return values, indices
+
+
+def one_hot(input, depth):
+    return _simple("one_hot", input, attrs={"depth": depth},
+                   out_dtype="float32")
+
+
+def clip(x, min, max, name=None):
+    return _simple("clip", x, attrs={"min": float(min), "max": float(max)},
+                   name=name)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _simple("clip_by_norm", x, attrs={"max_norm": float(max_norm)},
+                   name=name)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", act=act, name=name)
+    out = helper.create_tmp_variable(dtype=x.dtype)
+    helper.append_op(type="scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"scale": float(scale), "bias": float(bias),
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
+def cast(x, dtype):
+    from .tensor import cast as _cast
+    return _cast(x, dtype)
+
+
+def expand(x, expand_times, name=None):
+    return _simple("expand", x, attrs={"expand_times": list(expand_times)},
+                   name=name)
+
+
+def squeeze(input, axes, name=None):
+    return _simple("squeeze", input, attrs={"axes": list(axes)}, name=name)
+
+
+def unsqueeze(input, axes, name=None):
+    return _simple("unsqueeze", input, attrs={"axes": list(axes)}, name=name)
+
+
+def gather(input, index):
+    return _simple("gather", input, extra_inputs={"Index": index})
+
+
+def scatter(input, index, updates, name=None):
+    return _simple("scatter", input,
+                   extra_inputs={"Ids": index, "Updates": updates}, name=name)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _simple("pad", x, attrs={"paddings": list(paddings),
+                                    "pad_value": float(pad_value)}, name=name)
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_tmp_variable(dtype=inputs[0].dtype)
+    helper.append_op(type="multiplex",
+                     inputs={"X": inputs, "Ids": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper("cos_sim")
+    out = helper.create_tmp_variable(dtype=X.dtype)
+    xnorm = helper.create_tmp_variable(dtype=X.dtype, stop_gradient=True)
+    ynorm = helper.create_tmp_variable(dtype=X.dtype, stop_gradient=True)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xnorm],
+                              "YNorm": [ynorm]})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", param_attr=param_attr, act=act)
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=input.dtype, is_bias=False)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="row_conv",
+                     inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_tmp_variable(dtype=input.dtype)
+    helper.append_op(type="im2sequence", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"kernels": _pair(filter_size),
+                            "strides": _pair(stride),
+                            "paddings": _pair(padding) + _pair(padding)})
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Global step counter as a graph var (reference nn.py:3291); LR schedules
+    read it."""
+    helper = LayerHelper("global_step_counter")
+    counter_name = counter_name or "@STEP_COUNTER@"
+    gblock = helper.main_program.global_block()
+    if gblock.has_var(counter_name):
+        return gblock.var(counter_name)
+    counter = gblock.create_var(name=counter_name, dtype="int64", shape=[1],
+                                persistable=True)
+    from ..initializer import ConstantInitializer
+    helper.set_variable_initializer(
+        counter, ConstantInitializer(float(begin - 1)))
+    gblock.prepend_op(type="increment", inputs={"X": [counter]},
+                      outputs={"Out": [counter]}, attrs={"step": float(step)})
+    counter.stop_gradient = True
+    counter.desc.stop_gradient = True
+    return counter

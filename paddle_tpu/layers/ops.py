@@ -1,0 +1,81 @@
+"""Auto-generated unary-op wrappers (reference: python/paddle/fluid/layers/
+ops.py + layer_function_generator.py — ~40 wrappers from OpProtos)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "sigmoid", "logsigmoid", "exp", "relu", "tanh", "tanh_shrink",
+    "softshrink", "hard_shrink", "sqrt", "abs", "ceil", "floor", "round",
+    "reciprocal", "log", "square", "softplus", "softsign", "brelu",
+    "leaky_relu", "soft_relu", "elu", "relu6", "pow", "stanh",
+    "hard_sigmoid", "swish", "thresholded_relu", "gelu", "silu",
+    "softmax", "sign", "cumsum",
+]
+
+__all__ = list(_UNARY_OPS) + ["uniform_random", "gaussian_random",
+                              "uniform_random_batch_size_like",
+                              "gaussian_random_batch_size_like"]
+
+
+def _make_unary(op_type):
+    def f(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_tmp_variable(dtype=x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        return out
+    f.__name__ = op_type
+    f.__doc__ = f"Elementwise {op_type} (reference activation_op.cc)."
+    return f
+
+
+for _name in _UNARY_OPS:
+    globals()[_name] = _make_unary(_name)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_tmp_variable(dtype=dtype)
+    helper.append_op(type="uniform_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "min": min, "max": max, "seed": seed})
+    return out
+
+
+def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, seed=0):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_tmp_variable(dtype=dtype)
+    helper.append_op(type="gaussian_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "mean": mean, "std": std, "seed": seed})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32", min=-1.0,
+                                   max=1.0, seed=0, input_dim_idx=0,
+                                   output_dim_idx=0):
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_tmp_variable(dtype=dtype)
+    helper.append_op(type="uniform_random_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype, "min": min,
+                            "max": max, "seed": seed,
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, dtype="float32", mean=0.0,
+                                    std=1.0, seed=0, input_dim_idx=0,
+                                    output_dim_idx=0):
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_tmp_variable(dtype=dtype)
+    helper.append_op(type="gaussian_random_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "dtype": dtype, "mean": mean,
+                            "std": std, "seed": seed,
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out

@@ -1,0 +1,10 @@
+"""Op corpus: importing this package registers every op lowering."""
+
+from . import registry
+from . import basic_ops      # noqa: F401
+from . import math_ops       # noqa: F401
+from . import nn_ops         # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import extra_ops      # noqa: F401
+
+from .registry import register, op, get, try_get, registered_ops, NO_GRAD

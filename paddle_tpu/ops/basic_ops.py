@@ -1,0 +1,485 @@
+"""Basic ops: fills, randoms, casts, shape manipulation, indexing.
+
+TPU-native lowerings of the reference ops in paddle/fluid/operators/
+(fill_constant_op.cc, uniform_random_op.cc, gaussian_random_op.cc, cast_op.cc,
+scale_op.cc, reshape_op.cc, transpose_op.cc, concat_op.cc, split_op.cc,
+expand_op.cc, gather_op.cc, scatter_op.cc, one_hot_op.cc, top_k_op.cc,
+clip_op.cc, assign_op.cc, increment_op.cc, sign_op.cc …).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import NO_GRAD, op, register
+from .common import (in_var, out_var, same_as_input, set_out, to_np_dtype)
+
+
+# --- feed / fetch are executor-level (reference feed_op.cc/fetch_op.cc) -----
+register("feed", no_kernel=True, grad=NO_GRAD)
+register("fetch", no_kernel=True, grad=NO_GRAD)
+
+
+# --- fills ------------------------------------------------------------------
+
+def _fill_constant_infer(op_, block):
+    set_out(op_, block, "Out", op_.attr("shape"), op_.attr("dtype", "float32"))
+
+
+@op("fill_constant", infer_shape=_fill_constant_infer, grad=NO_GRAD)
+def _fill_constant(ctx, op_, ins):
+    dtype = to_np_dtype(op_.attr("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(op_.attr("shape")),
+                             op_.attr("value", 0.0), dtype=dtype)]}
+
+
+def _fill_like_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    if iv is not None:
+        set_out(op_, block, "Out", iv.shape, op_.attr("dtype") or iv.dtype)
+
+
+@op("fill_zeros_like", infer_shape=_fill_like_infer, grad=NO_GRAD)
+def _fill_zeros_like(ctx, op_, ins):
+    x = ins["X"][0]
+    return {"Out": [jnp.zeros_like(x)]}
+
+
+def _fill_bsl_infer(op_, block):
+    shape = list(op_.attr("shape"))
+    iv = in_var(op_, block, "Input")
+    in_idx = op_.attr("input_dim_idx", 0)
+    out_idx = op_.attr("output_dim_idx", 0)
+    if iv is not None and iv.shape is not None:
+        shape[out_idx] = iv.shape[in_idx]
+    set_out(op_, block, "Out", shape, op_.attr("dtype", "float32"))
+
+
+@op("fill_constant_batch_size_like", infer_shape=_fill_bsl_infer, grad=NO_GRAD)
+def _fill_constant_bsl(ctx, op_, ins):
+    x = ins["Input"][0]
+    shape = list(op_.attr("shape"))
+    shape[op_.attr("output_dim_idx", 0)] = x.shape[op_.attr("input_dim_idx", 0)]
+    dtype = to_np_dtype(op_.attr("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(shape), op_.attr("value", 0.0), dtype=dtype)]}
+
+
+def _fill_tensor_infer(op_, block):
+    set_out(op_, block, "Out", op_.attr("shape"), op_.attr("dtype", "float32"))
+
+
+@op("fill_constant_tensor", infer_shape=_fill_tensor_infer, grad=NO_GRAD)
+def _fill_constant_tensor(ctx, op_, ins):
+    """Materialize a literal ndarray (layers.assign of numpy data)."""
+    vals = np.asarray(op_.attr("values"),
+                      dtype=to_np_dtype(op_.attr("dtype", "float32")))
+    return {"Out": [jnp.asarray(vals.reshape(tuple(op_.attr("shape"))))]}
+
+
+def _arg_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    axis = op_.attr("axis", 0)
+    if iv is not None and iv.shape is not None:
+        shape = [d for i, d in enumerate(iv.shape) if i != axis % len(iv.shape)]
+        set_out(op_, block, "Out", shape or [1], "int64")
+
+
+@op("arg_max", infer_shape=_arg_infer, grad=NO_GRAD)
+def _arg_max(ctx, op_, ins):
+    return {"Out": [jnp.argmax(jnp.asarray(ins["X"][0]),
+                               axis=op_.attr("axis", 0)).astype(jnp.int64)]}
+
+
+@op("arg_min", infer_shape=_arg_infer, grad=NO_GRAD)
+def _arg_min(ctx, op_, ins):
+    return {"Out": [jnp.argmin(jnp.asarray(ins["X"][0]),
+                               axis=op_.attr("axis", 0)).astype(jnp.int64)]}
+
+
+# --- randoms ----------------------------------------------------------------
+
+@op("uniform_random", infer_shape=_fill_constant_infer, grad=NO_GRAD)
+def _uniform_random(ctx, op_, ins):
+    dtype = to_np_dtype(op_.attr("dtype", "float32"))
+    key = ctx.next_rng(op_)
+    return {"Out": [jax.random.uniform(
+        key, tuple(op_.attr("shape")), dtype=jnp.float32,
+        minval=op_.attr("min", -1.0), maxval=op_.attr("max", 1.0)
+    ).astype(dtype)]}
+
+
+@op("gaussian_random", infer_shape=_fill_constant_infer, grad=NO_GRAD)
+def _gaussian_random(ctx, op_, ins):
+    dtype = to_np_dtype(op_.attr("dtype", "float32"))
+    key = ctx.next_rng(op_)
+    out = op_.attr("mean", 0.0) + op_.attr("std", 1.0) * jax.random.normal(
+        key, tuple(op_.attr("shape")), dtype=jnp.float32)
+    return {"Out": [out.astype(dtype)]}
+
+
+@op("uniform_random_batch_size_like", infer_shape=_fill_bsl_infer, grad=NO_GRAD)
+def _uniform_random_bsl(ctx, op_, ins):
+    x = ins["Input"][0]
+    shape = list(op_.attr("shape"))
+    shape[op_.attr("output_dim_idx", 0)] = x.shape[op_.attr("input_dim_idx", 0)]
+    dtype = to_np_dtype(op_.attr("dtype", "float32"))
+    key = ctx.next_rng(op_)
+    return {"Out": [jax.random.uniform(
+        key, tuple(shape), dtype=jnp.float32,
+        minval=op_.attr("min", -1.0), maxval=op_.attr("max", 1.0)).astype(dtype)]}
+
+
+@op("gaussian_random_batch_size_like", infer_shape=_fill_bsl_infer, grad=NO_GRAD)
+def _gaussian_random_bsl(ctx, op_, ins):
+    x = ins["Input"][0]
+    shape = list(op_.attr("shape"))
+    shape[op_.attr("output_dim_idx", 0)] = x.shape[op_.attr("input_dim_idx", 0)]
+    dtype = to_np_dtype(op_.attr("dtype", "float32"))
+    key = ctx.next_rng(op_)
+    out = op_.attr("mean", 0.0) + op_.attr("std", 1.0) * jax.random.normal(
+        key, tuple(shape), dtype=jnp.float32)
+    return {"Out": [out.astype(dtype)]}
+
+
+# --- assign / cast / scale --------------------------------------------------
+
+@op("assign", infer_shape=same_as_input("X", "Out"))
+def _assign(ctx, op_, ins):
+    return {"Out": [jnp.asarray(ins["X"][0])]}
+
+
+def _cast_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    set_out(op_, block, "Out", iv.shape if iv else None,
+            op_.attr("out_dtype", "float32"))
+
+
+def _cast_grad(fwd, no_grad_set):
+    from ..framework.desc import OpDesc
+    from ..framework.framework import grad_var_name
+    xname = fwd.input("X")[0]
+    if xname in no_grad_set:
+        return []
+    return [OpDesc(type="cast",
+                   inputs={"X": [grad_var_name(fwd.output("Out")[0])]},
+                   outputs={"Out": [grad_var_name(xname)]},
+                   attrs={"in_dtype": fwd.attr("out_dtype", "float32"),
+                          "out_dtype": fwd.attr("in_dtype", "float32")})]
+
+
+@op("cast", infer_shape=_cast_infer, grad=_cast_grad)
+def _cast(ctx, op_, ins):
+    return {"Out": [jnp.asarray(ins["X"][0]).astype(
+        to_np_dtype(op_.attr("out_dtype", "float32")))]}
+
+
+@op("scale", infer_shape=same_as_input())
+def _scale(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    s = op_.attr("scale", 1.0)
+    b = op_.attr("bias", 0.0)
+    if op_.attr("bias_after_scale", True):
+        return {"Out": [x * s + b]}
+    return {"Out": [(x + b) * s]}
+
+
+@op("increment", infer_shape=same_as_input(), grad=NO_GRAD)
+def _increment(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    return {"Out": [x + jnp.asarray(op_.attr("step", 1.0), dtype=x.dtype)]}
+
+
+@op("sign", infer_shape=same_as_input(), grad=NO_GRAD)
+def _sign(ctx, op_, ins):
+    return {"Out": [jnp.sign(jnp.asarray(ins["X"][0]))]}
+
+
+@op("clip", infer_shape=same_as_input())
+def _clip(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    return {"Out": [jnp.clip(x, op_.attr("min"), op_.attr("max"))]}
+
+
+@op("clip_by_norm", infer_shape=same_as_input())
+def _clip_by_norm(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    max_norm = op_.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(x * x))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [x * scale.astype(x.dtype)]}
+
+
+# --- shape manipulation -----------------------------------------------------
+
+def _reshape_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    shape = list(op_.attr("shape"))
+    if iv is not None and iv.shape is not None and all(
+            s is not None for s in iv.shape):
+        src = list(iv.shape)
+        # resolve 0 (copy dim) then -1 (infer)
+        shape = [src[i] if s == 0 else s for i, s in enumerate(shape)]
+        if -1 in shape and all(s > 0 for s in src):
+            total = int(np.prod(src))
+            known = int(np.prod([s for s in shape if s != -1]))
+            shape[shape.index(-1)] = total // known
+    set_out(op_, block, "Out", shape, iv.dtype if iv else None)
+
+
+@op("reshape", infer_shape=_reshape_infer)
+def _reshape(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    shape = list(op_.attr("shape"))
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return {"Out": [x.reshape(tuple(shape))]}
+
+
+def _transpose_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    axis = op_.attr("axis")
+    if iv is not None and iv.shape is not None:
+        set_out(op_, block, "Out", [iv.shape[a] for a in axis], iv.dtype)
+
+
+@op("transpose", infer_shape=_transpose_infer)
+def _transpose(ctx, op_, ins):
+    return {"Out": [jnp.transpose(jnp.asarray(ins["X"][0]), op_.attr("axis"))]}
+
+
+def _concat_infer(op_, block):
+    axis = op_.attr("axis", 0)
+    shapes = []
+    for i in range(len(op_.desc.inputs.get("X", []))):
+        v = in_var(op_, block, "X", i)
+        if v is None or v.shape is None:
+            return
+        shapes.append(list(v.shape))
+    out = list(shapes[0])
+    if any(s[axis] is None or s[axis] < 0 for s in shapes):
+        out[axis] = -1
+    else:
+        out[axis] = sum(s[axis] for s in shapes)
+    set_out(op_, block, "Out", out, in_var(op_, block, "X").dtype)
+
+
+@op("concat", infer_shape=_concat_infer)
+def _concat(ctx, op_, ins):
+    return {"Out": [jnp.concatenate([jnp.asarray(x) for x in ins["X"]],
+                                    axis=op_.attr("axis", 0))]}
+
+
+def _split_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    axis = op_.attr("axis", 0)
+    n = len(op_.desc.outputs.get("Out", []))
+    sections = op_.attr("sections") or None
+    if iv is None or iv.shape is None:
+        return
+    for i in range(n):
+        s = list(iv.shape)
+        if sections:
+            s[axis] = sections[i]
+        elif s[axis] is not None and s[axis] > 0:
+            s[axis] = s[axis] // n
+        set_out_i(op_, block, "Out", i, s, iv.dtype)
+
+
+def set_out_i(op_, block, slot, i, shape, dtype):
+    v = out_var(op_, block, slot, i)
+    if v is not None:
+        v.shape = list(shape) if shape is not None else None
+        v.dtype = dtype
+
+
+@op("split", infer_shape=_split_infer)
+def _split(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    axis = op_.attr("axis", 0)
+    sections = op_.attr("sections") or None
+    n = op_.attr("num", 0) or len(op_.desc.outputs["Out"])
+    if sections:
+        idxs = np.cumsum(sections)[:-1].tolist()
+        parts = jnp.split(x, idxs, axis=axis)
+    else:
+        parts = jnp.split(x, n, axis=axis)
+    return {"Out": list(parts)}
+
+
+def _expand_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    times = op_.attr("expand_times")
+    if iv is not None and iv.shape is not None:
+        set_out(op_, block, "Out",
+                [None if d is None else d * t
+                 for d, t in zip(iv.shape, times)], iv.dtype)
+
+
+@op("expand", infer_shape=_expand_infer)
+def _expand(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    return {"Out": [jnp.tile(x, tuple(op_.attr("expand_times")))]}
+
+
+def _squeeze_axes(shape, axes):
+    if axes:
+        axes = [a % len(shape) for a in axes]
+        return [d for i, d in enumerate(shape) if i not in axes]
+    return [d for d in shape if d != 1]
+
+
+def _squeeze_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    if iv is not None and iv.shape is not None:
+        set_out(op_, block, "Out",
+                _squeeze_axes(list(iv.shape), op_.attr("axes") or []), iv.dtype)
+
+
+@op("squeeze", infer_shape=_squeeze_infer)
+def _squeeze(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    axes = op_.attr("axes") or []
+    return {"Out": [x.reshape(tuple(_squeeze_axes(list(x.shape), axes)))]}
+
+
+def _unsqueeze_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    if iv is not None and iv.shape is not None:
+        shape = list(iv.shape)
+        for a in sorted(op_.attr("axes")):
+            shape.insert(a, 1)
+        set_out(op_, block, "Out", shape, iv.dtype)
+
+
+@op("unsqueeze", infer_shape=_unsqueeze_infer)
+def _unsqueeze(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    for a in sorted(op_.attr("axes")):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x]}
+
+
+# --- indexing ---------------------------------------------------------------
+
+def _gather_infer(op_, block):
+    xv, iv = in_var(op_, block, "X"), in_var(op_, block, "Index")
+    if xv is not None and xv.shape is not None and iv is not None \
+            and iv.shape is not None:
+        set_out(op_, block, "Out", list(iv.shape[:1]) + list(xv.shape[1:]),
+                xv.dtype)
+
+
+@op("gather", infer_shape=_gather_infer, non_diff_inputs=("Index",))
+def _gather(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    idx = jnp.asarray(ins["Index"][0]).reshape(-1)
+    return {"Out": [jnp.take(x, idx, axis=0)]}
+
+
+@op("scatter", infer_shape=same_as_input("X", "Out"),
+    non_diff_inputs=("Ids",))
+def _scatter(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    ids = jnp.asarray(ins["Ids"][0]).reshape(-1)
+    upd = jnp.asarray(ins["Updates"][0])
+    return {"Out": [x.at[ids].set(upd)]}
+
+
+def _one_hot_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    if iv is not None and iv.shape is not None:
+        shape = list(iv.shape)
+        if shape and shape[-1] == 1:
+            shape = shape[:-1]
+        set_out(op_, block, "Out", shape + [op_.attr("depth")], "float32")
+
+
+@op("one_hot", infer_shape=_one_hot_infer, grad=NO_GRAD)
+def _one_hot(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    if x.ndim and x.shape[-1] == 1:
+        x = x.reshape(x.shape[:-1])
+    return {"Out": [jax.nn.one_hot(x, op_.attr("depth"), dtype=jnp.float32)]}
+
+
+def _top_k_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    k = op_.attr("k", 1)
+    if iv is not None and iv.shape is not None:
+        shape = list(iv.shape[:-1]) + [k]
+        set_out(op_, block, "Out", shape, iv.dtype)
+        set_out(op_, block, "Indices", shape, "int64")
+
+
+@op("top_k", infer_shape=_top_k_infer, grad=NO_GRAD)
+def _top_k(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    vals, idxs = jax.lax.top_k(x, op_.attr("k", 1))
+    return {"Out": [vals], "Indices": [idxs.astype(jnp.int64)]}
+
+
+@op("multiplex", non_diff_inputs=("Ids",))
+def _multiplex(ctx, op_, ins):
+    ids = jnp.asarray(ins["Ids"][0]).reshape(-1)
+    stack = jnp.stack([jnp.asarray(x) for x in ins["X"]], axis=0)
+    rows = jnp.arange(stack.shape[1])
+    return {"Out": [stack[ids, rows]]}
+
+
+# --- compare / logical (reference compare_op.cc, logical_op.cc) -------------
+
+def _cmp_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None:
+        set_out(op_, block, "Out", xv.shape, "bool")
+
+
+_cmp_fns = {"less_than": jnp.less, "less_equal": jnp.less_equal,
+            "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+            "equal": jnp.equal, "not_equal": jnp.not_equal}
+
+
+def _make_cmp(fn):
+    def lower(ctx, op_, ins):
+        return {"Out": [fn(jnp.asarray(ins["X"][0]), jnp.asarray(ins["Y"][0]))]}
+    return lower
+
+
+for _n, _f in _cmp_fns.items():
+    register(_n, lower=_make_cmp(_f), infer_shape=_cmp_infer, grad=NO_GRAD)
+
+_logical_fns = {"logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+                "logical_xor": jnp.logical_xor}
+
+
+def _make_logical(fn):
+    def lower(ctx, op_, ins):
+        return {"Out": [fn(jnp.asarray(ins["X"][0]), jnp.asarray(ins["Y"][0]))]}
+    return lower
+
+
+for _n, _f in _logical_fns.items():
+    register(_n, lower=_make_logical(_f), infer_shape=_cmp_infer, grad=NO_GRAD)
+
+
+@op("logical_not", infer_shape=_cmp_infer, grad=NO_GRAD)
+def _logical_not(ctx, op_, ins):
+    return {"Out": [jnp.logical_not(jnp.asarray(ins["X"][0]))]}
+
+
+@op("is_empty", grad=NO_GRAD)
+def _is_empty(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    return {"Out": [jnp.asarray(x.size == 0)]}
+
+
+# --- shape/metadata queries -------------------------------------------------
+
+@op("shape", grad=NO_GRAD)
+def _shape(ctx, op_, ins):
+    x = ins["Input"][0] if "Input" in op_.desc.inputs else ins["X"][0]
+    return {"Out": [jnp.asarray(np.asarray(jnp.asarray(x).shape,
+                                           dtype=np.int64))]}

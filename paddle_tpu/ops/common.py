@@ -1,0 +1,104 @@
+"""Shared helpers for op lowerings and shape inference."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def to_np_dtype(name: str):
+    if name == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(name)
+
+
+def broadcast_y_to_x(x, y, axis: int):
+    """Paddle elementwise broadcast: align y's dims to x starting at `axis`
+    (reference: operators/elementwise_op_function.h). axis==-1 means align to
+    the trailing dims."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if y.ndim == 0 or x.shape == y.shape:
+        return y
+    # Paddle allows a trailing run of size-1 dims in y beyond the aligned
+    # region (e.g. x:(N,C), y:(N,1) with axis=0); squeeze them so the
+    # alignment fits.
+    if axis == -1:
+        axis = x.ndim - y.ndim
+        while axis < 0 and y.shape[-1] == 1:
+            y = y.reshape(y.shape[:-1])
+            axis += 1
+    else:
+        while axis + y.ndim > x.ndim and y.shape[-1] == 1:
+            y = y.reshape(y.shape[:-1])
+    assert axis >= 0 and axis + y.ndim <= x.ndim, (
+        f"cannot broadcast y{tuple(y.shape)} to x{tuple(x.shape)} at axis {axis}")
+    new_shape = (1,) * axis + tuple(y.shape) + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+# --- shape inference helpers ------------------------------------------------
+
+def out_var(op, block, slot="Out", idx=0):
+    names = op.desc.outputs.get(slot, [])
+    if idx >= len(names):
+        return None
+    name = names[idx]
+    return block.desc.vars.get(name) or _find_up(block, name)
+
+
+def in_var(op, block, slot="X", idx=0):
+    names = op.desc.inputs.get(slot, [])
+    if idx >= len(names):
+        return None
+    return _find_up(block, names[idx])
+
+
+def _find_up(block, name):
+    b = block
+    while b is not None:
+        if b.desc.has_var(name):
+            return b.desc.var(name)
+        b = b.parent_block
+    return None
+
+
+def set_out(op, block, slot, shape, dtype):
+    v = out_var(op, block, slot)
+    if v is not None:
+        v.shape = list(shape) if shape is not None else None
+        if dtype is not None:
+            v.dtype = dtype
+
+
+def same_as_input(in_slot="X", out_slot="Out"):
+    def infer(op, block):
+        iv = in_var(op, block, in_slot)
+        if iv is not None:
+            set_out(op, block, out_slot, iv.shape, iv.dtype)
+    return infer
+
+
+def elementwise_infer(op, block):
+    xv = in_var(op, block, "X")
+    if xv is not None:
+        set_out(op, block, "Out", xv.shape, xv.dtype)
+
+
+def matmul_shape(xs: Optional[List[int]], ys: Optional[List[int]],
+                 tx: bool, ty: bool) -> Optional[List[int]]:
+    if xs is None or ys is None:
+        return None
+    xs, ys = list(xs), list(ys)
+    if len(xs) == 1:
+        xs = [1, xs[0]]
+    if len(ys) == 1:
+        ys = [ys[0], 1]
+    if tx:
+        xs[-2], xs[-1] = xs[-1], xs[-2]
+    if ty:
+        ys[-2], ys[-1] = ys[-1], ys[-2]
+    batch = xs[:-2] or ys[:-2]
+    return batch + [xs[-2], ys[-1]]

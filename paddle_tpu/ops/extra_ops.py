@@ -1,0 +1,125 @@
+"""Extra NN ops: pad, maxout, row_conv, im2sequence, nce, pool variants
+(reference: pad_op.cc, maxout_op.cc, row_conv_op.cc, im2sequence_op.cc,
+nce_op.cc, spp_op.cc, unpool_op.cc, roi_pool_op.cc)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import NO_GRAD, op
+from .common import in_var, same_as_input, set_out
+
+
+def _pad_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    p = op_.attr("paddings")
+    if iv is not None and iv.shape is not None:
+        shape = [None if d is None else d + p[2 * i] + p[2 * i + 1]
+                 for i, d in enumerate(iv.shape)]
+        set_out(op_, block, "Out", shape, iv.dtype)
+
+
+@op("pad", infer_shape=_pad_infer)
+def _pad(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    p = op_.attr("paddings")
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pads, constant_values=op_.attr("pad_value", 0.0))]}
+
+
+def _maxout_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    g = op_.attr("groups")
+    if iv is not None and iv.shape is not None:
+        n, c, h, w = iv.shape
+        set_out(op_, block, "Out", [n, None if c is None else c // g, h, w],
+                iv.dtype)
+
+
+@op("maxout", infer_shape=_maxout_infer)
+def _maxout(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    g = op_.attr("groups")
+    n, c, h, w = x.shape
+    return {"Out": [jnp.max(x.reshape(n, c // g, g, h, w), axis=2)]}
+
+
+@op("row_conv")
+def _row_conv(ctx, op_, ins):
+    """Lookahead row convolution (reference row_conv_op.cc): for each t,
+    out[t] = sum_{i=0..k} x[t+i] * filter[i]. Accepts (T, D) or (N, T, D)."""
+    x = jnp.asarray(ins["X"][0])
+    w = jnp.asarray(ins["Filter"][0])   # (k+1, D)
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[None]
+    k = w.shape[0]
+    T = x.shape[1]
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        out = out + xp[:, i:i + T, :] * w[i]
+    if squeeze:
+        out = out[0]
+    return {"Out": [out]}
+
+
+@op("im2sequence", grad=None)
+def _im2sequence(ctx, op_, ins):
+    """Image patches -> sequence rows (reference im2sequence_op.cc): output
+    (N*OH*OW, kh*kw*C)."""
+    x = jnp.asarray(ins["X"][0])
+    kh, kw = op_.attr("kernels")
+    sh, sw = op_.attr("strides", [1, 1])
+    p = op_.attr("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw),
+        padding=((p[0], p[2]), (p[1], p[3])),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: (N, C*kh*kw, OH, OW)
+    np_, ckk, oh, ow = patches.shape
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ckk)
+    return {"Out": [out]}
+
+
+def _nce_infer(op_, block):
+    xv = in_var(op_, block, "Input")
+    if xv is not None and xv.shape is not None:
+        set_out(op_, block, "Cost", [xv.shape[0], 1], xv.dtype)
+
+
+@op("nce", infer_shape=_nce_infer, non_diff_inputs=("Label", "SampleWeight"))
+def _nce(ctx, op_, ins):
+    """Noise-contrastive estimation (reference nce_op.cc): binary logistic
+    loss on the true class vs uniformly sampled negatives."""
+    x = jnp.asarray(ins["Input"][0])          # (N, D)
+    label = jnp.asarray(ins["Label"][0]).reshape(-1)  # (N,)
+    w = jnp.asarray(ins["Weight"][0])         # (C, D)
+    b = jnp.asarray(ins["Bias"][0]).reshape(-1) if ins.get("Bias") and \
+        ins["Bias"][0] is not None else None
+    num_classes = op_.attr("num_total_classes")
+    num_neg = op_.attr("num_neg_samples", 10)
+    key = ctx.next_rng(op_)
+    n = x.shape[0]
+    neg = jax.random.randint(key, (n, num_neg), 0, num_classes)
+
+    def logit(ids):
+        l = jnp.einsum("nd,nkd->nk", x, w[ids])
+        if b is not None:
+            l = l + b[ids]
+        return l
+
+    pos_logit = logit(label[:, None])          # (N, 1)
+    neg_logit = logit(neg)                     # (N, K)
+    pos_loss = jnp.log1p(jnp.exp(-pos_logit))
+    neg_loss = jnp.log1p(jnp.exp(neg_logit))
+    cost = pos_loss.sum(axis=1, keepdims=True) + \
+        neg_loss.sum(axis=1, keepdims=True)
+    sample_logits = jnp.concatenate([pos_logit, neg_logit], axis=1)
+    sample_labels = jnp.concatenate(
+        [label[:, None], neg], axis=1).astype(jnp.int64)
+    return {"Cost": [cost], "SampleLogits": [sample_logits],
+            "SampleLabels": [sample_labels]}

@@ -1,0 +1,312 @@
+"""Math ops: GEMM, elementwise+broadcast, activations, reductions.
+
+TPU-native lowerings of the reference ops (mul_op.cc, matmul_op.cc,
+elementwise_*_op.cc + elementwise_op_function.h, activation_op.cc — 20+
+activations, reduce_op.cc, sum_op.cc, mean_op.cc, cumsum_op.cc, cos_sim_op.cc,
+norm ops). Matmuls map straight onto the MXU via jnp.matmul/einsum; elementwise
+ops fuse into neighbours under XLA, so there is no hand-written fusion layer
+like the reference's math functors (operators/math/math_function.*).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import NO_GRAD, op, register
+from .common import (broadcast_y_to_x, in_var, matmul_shape, out_var,
+                     same_as_input, set_out)
+
+
+# --- GEMM family ------------------------------------------------------------
+
+def _flat2(x, num_col_dims):
+    """Flatten to 2-D the way mul_op does (reference mul_op.cc): leading
+    num_col_dims dims become rows, the rest columns."""
+    shape = x.shape
+    rows = int(np.prod(shape[:num_col_dims])) if num_col_dims else 1
+    cols = int(np.prod(shape[num_col_dims:])) if num_col_dims < len(shape) else 1
+    return x.reshape(rows, cols)
+
+
+def _mul_infer(op_, block):
+    xv, yv = in_var(op_, block, "X"), in_var(op_, block, "Y")
+    if xv is None or yv is None or xv.shape is None or yv.shape is None:
+        return
+    xn = op_.attr("x_num_col_dims", 1)
+    yn = op_.attr("y_num_col_dims", 1)
+    set_out(op_, block, "Out",
+            list(xv.shape[:xn]) + list(yv.shape[yn:]), xv.dtype)
+
+
+@op("mul", infer_shape=_mul_infer)
+def _mul(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    y = jnp.asarray(ins["Y"][0])
+    xn = op_.attr("x_num_col_dims", 1)
+    yn = op_.attr("y_num_col_dims", 1)
+    out2d = _flat2(x, xn) @ _flat2(y, yn)
+    out_shape = x.shape[:xn] + y.shape[yn:]
+    return {"Out": [out2d.reshape(out_shape)]}
+
+
+def _matmul_infer(op_, block):
+    xv, yv = in_var(op_, block, "X"), in_var(op_, block, "Y")
+    if xv is None or yv is None:
+        return
+    set_out(op_, block, "Out",
+            matmul_shape(xv.shape and list(xv.shape), yv.shape and list(yv.shape),
+                         op_.attr("transpose_X", False),
+                         op_.attr("transpose_Y", False)),
+            xv.dtype)
+
+
+@op("matmul", infer_shape=_matmul_infer)
+def _matmul(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    y = jnp.asarray(ins["Y"][0])
+    if op_.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if op_.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = op_.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+def _bilinear_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    wv = in_var(op_, block, "Weight")
+    if xv is not None and xv.shape is not None and wv is not None \
+            and wv.shape is not None:
+        set_out(op_, block, "Out", [xv.shape[0], wv.shape[0]], xv.dtype)
+
+
+@op("bilinear_tensor_product", infer_shape=_bilinear_infer)
+def _bilinear_tensor_product(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])      # (B, M)
+    y = jnp.asarray(ins["Y"][0])      # (B, N)
+    w = jnp.asarray(ins["Weight"][0])  # (O, M, N)
+    out = jnp.einsum("bm,omn,bn->bo", x, w, y)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + jnp.asarray(ins["Bias"][0])
+    return {"Out": [out]}
+
+
+# --- elementwise with axis broadcast ---------------------------------------
+
+_elementwise_fns = {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+    "elementwise_div": jnp.divide,
+    "elementwise_max": jnp.maximum,
+    "elementwise_min": jnp.minimum,
+    "elementwise_pow": jnp.power,
+}
+
+
+def _ew_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None:
+        set_out(op_, block, "Out", xv.shape, xv.dtype)
+
+
+def _make_ew(fn):
+    def lower(ctx, op_, ins):
+        x = jnp.asarray(ins["X"][0])
+        y = broadcast_y_to_x(x, ins["Y"][0], op_.attr("axis", -1))
+        return {"Out": [fn(x, y)]}
+    return lower
+
+
+for _name, _fn in _elementwise_fns.items():
+    register(_name, lower=_make_ew(_fn), infer_shape=_ew_infer)
+
+
+# --- activations (reference activation_op.cc) -------------------------------
+
+def _softshrink(x, lam=0.5):
+    return jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0))
+
+
+_activations = {
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "exp": lambda x, a: jnp.exp(x),
+    "relu": lambda x, a: jax.nn.relu(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+    "softshrink": lambda x, a: _softshrink(x, a.attr("lambda", 0.5)),
+    "hard_shrink": lambda x, a: jnp.where(
+        jnp.abs(x) > a.attr("threshold", 0.5), x, 0.0),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "ceil": lambda x, a: jnp.ceil(x),
+    "floor": lambda x, a: jnp.floor(x),
+    "round": lambda x, a: jnp.round(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "log": lambda x, a: jnp.log(x),
+    "square": lambda x, a: jnp.square(x),
+    "softplus": lambda x, a: jax.nn.softplus(x),
+    "softsign": lambda x, a: x / (1.0 + jnp.abs(x)),
+    "brelu": lambda x, a: jnp.clip(x, a.attr("t_min", 0.0), a.attr("t_max", 24.0)),
+    "leaky_relu": lambda x, a: jnp.where(x >= 0, x, a.attr("alpha", 0.02) * x),
+    "soft_relu": lambda x, a: jnp.log1p(jnp.exp(
+        jnp.clip(x, -a.attr("threshold", 40.0), a.attr("threshold", 40.0)))),
+    "elu": lambda x, a: jnp.where(x >= 0, x, a.attr("alpha", 1.0)
+                                  * (jnp.exp(x) - 1.0)),
+    "relu6": lambda x, a: jnp.clip(x, 0.0, a.attr("threshold", 6.0)),
+    "pow": lambda x, a: jnp.power(x, a.attr("factor", 1.0)),
+    "stanh": lambda x, a: a.attr("scale_b", 1.7159) * jnp.tanh(
+        a.attr("scale_a", 2.0 / 3.0) * x),
+    "hard_sigmoid": lambda x, a: jnp.clip(
+        a.attr("slope", 0.2) * x + a.attr("offset", 0.5), 0.0, 1.0),
+    "swish": lambda x, a: x * jax.nn.sigmoid(a.attr("beta", 1.0) * x),
+    "thresholded_relu": lambda x, a: jnp.where(
+        x > a.attr("threshold", 1.0), x, 0.0),
+    "gelu": lambda x, a: jax.nn.gelu(x, approximate=False),
+    "silu": lambda x, a: jax.nn.silu(x),
+}
+
+
+def _make_act(fn):
+    def lower(ctx, op_, ins):
+        x = jnp.asarray(ins["X"][0])
+        return {"Out": [fn(x, op_)]}
+    return lower
+
+
+for _name, _fn in _activations.items():
+    register(_name, lower=_make_act(_fn), infer_shape=same_as_input())
+
+
+# --- reductions -------------------------------------------------------------
+
+def _reduce_dims(op_, ndim):
+    if op_.attr("reduce_all", False):
+        return tuple(range(ndim))
+    dim = op_.attr("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % ndim for d in dim)
+
+
+def _reduce_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    if iv is None or iv.shape is None:
+        return
+    nd = len(iv.shape)
+    dims = _reduce_dims(op_, nd)
+    keep = op_.attr("keep_dim", False)
+    if op_.attr("reduce_all", False):
+        shape = [1] * nd if keep else [1]
+    else:
+        shape = [1 if i in dims else d for i, d in enumerate(iv.shape)] if keep \
+            else [d for i, d in enumerate(iv.shape) if i not in dims]
+        shape = shape or [1]
+    set_out(op_, block, "Out", shape, iv.dtype)
+
+
+_reduce_fns = {
+    "reduce_sum": jnp.sum, "reduce_mean": jnp.mean, "reduce_max": jnp.max,
+    "reduce_min": jnp.min, "reduce_prod": jnp.prod,
+}
+
+
+def _make_reduce(fn):
+    def lower(ctx, op_, ins):
+        x = jnp.asarray(ins["X"][0])
+        dims = _reduce_dims(op_, x.ndim)
+        keep = op_.attr("keep_dim", False)
+        out = fn(x, axis=dims, keepdims=keep)
+        if out.ndim == 0:
+            out = out.reshape(1)
+        return {"Out": [out]}
+    return lower
+
+
+for _name, _fn in _reduce_fns.items():
+    register(_name, lower=_make_reduce(_fn), infer_shape=_reduce_infer)
+
+
+def _mean_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    set_out(op_, block, "Out", [1], iv.dtype if iv else "float32")
+
+
+@op("mean", infer_shape=_mean_infer)
+def _mean(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    return {"Out": [jnp.mean(x).reshape(1)]}
+
+
+def _sum_infer(op_, block):
+    iv = in_var(op_, block, "X", 0)
+    if iv is not None:
+        set_out(op_, block, "Out", iv.shape, iv.dtype)
+
+
+@op("sum", infer_shape=_sum_infer)
+def _sum(ctx, op_, ins):
+    xs = [jnp.asarray(x) for x in ins["X"] if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@op("cumsum", infer_shape=same_as_input())
+def _cumsum(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    axis = op_.attr("axis", -1)
+    if op_.attr("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if op_.attr("exclusive", False):
+        # shift by one along axis: out[i] = sum of x[:i]
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(0, x.shape[axis])
+        out = jnp.pad(out, pad)[tuple(sl)]
+    if op_.attr("reverse", False):
+        out = jnp.flip(out, axis)
+    return {"Out": [out]}
+
+
+# --- similarity / norms -----------------------------------------------------
+
+def _cos_sim_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None and xv.shape is not None:
+        set_out(op_, block, "Out", [xv.shape[0], 1], xv.dtype)
+        set_out(op_, block, "XNorm", [xv.shape[0], 1], xv.dtype)
+    yv = in_var(op_, block, "Y")
+    if yv is not None and yv.shape is not None:
+        set_out(op_, block, "YNorm", [yv.shape[0], 1], yv.dtype)
+
+
+@op("cos_sim", infer_shape=_cos_sim_infer)
+def _cos_sim(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    y = jnp.asarray(ins["Y"][0])
+    xn = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=1, keepdims=True))
+    out = jnp.sum(x * y, axis=1, keepdims=True) / (xn * yn)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@op("norm", infer_shape=same_as_input())
+def _norm(ctx, op_, ins):
+    # l2-normalize along axis (reference norm_op.cc used by l2_normalize)
+    x = jnp.asarray(ins["X"][0])
+    axis = op_.attr("axis", -1)
+    eps = op_.attr("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": [x / n], "Norm": [n]}

@@ -1,0 +1,532 @@
+"""NN ops: conv/pool/norm/softmax/losses/embedding/dropout/metrics.
+
+TPU-native lowerings of the reference ops (conv_op.cc + conv_cudnn_op.cu.cc,
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, softmax_op.cc,
+cross_entropy_op.cc, softmax_with_cross_entropy_op.cc, lookup_table_op.cc,
+dropout_op.cc, lrn_op.cc, accuracy_op.cc, auc_op.cc, loss ops…). Layout is
+NCHW to match the reference's user-visible semantics; XLA relayouts for the
+MXU internally, so no data_layout_transform pass is needed (reference
+framework/data_layout_transform.cc becomes a compiler concern).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.desc import OpDesc
+from ..framework.framework import grad_var_name
+from .registry import NO_GRAD, op, register
+from .common import in_var, out_var, same_as_input, set_out, to_np_dtype
+
+
+# --- softmax ----------------------------------------------------------------
+
+@op("softmax", infer_shape=same_as_input())
+def _softmax(ctx, op_, ins):
+    return {"Out": [jax.nn.softmax(jnp.asarray(ins["X"][0]), axis=-1)]}
+
+
+def _ce_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None and xv.shape is not None:
+        set_out(op_, block, "Y", list(xv.shape[:-1]) + [1], xv.dtype)
+
+
+@op("cross_entropy", infer_shape=_ce_infer, non_diff_inputs=("Label",))
+def _cross_entropy(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    label = jnp.asarray(ins["Label"][0])
+    if op_.attr("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.clip(x, 1e-12, None)),
+                        axis=-1, keepdims=True)
+    else:
+        idx = label.reshape(label.shape[0], -1)[:, :1].astype(jnp.int32)
+        picked = jnp.take_along_axis(x, idx, axis=-1)
+        loss = -jnp.log(jnp.clip(picked, 1e-12, None))
+    return {"Y": [loss]}
+
+
+def _swce_infer(op_, block):
+    xv = in_var(op_, block, "Logits")
+    if xv is not None and xv.shape is not None:
+        set_out(op_, block, "Softmax", xv.shape, xv.dtype)
+        set_out(op_, block, "Loss", list(xv.shape[:-1]) + [1], xv.dtype)
+
+
+@op("softmax_with_cross_entropy", infer_shape=_swce_infer,
+    non_diff_inputs=("Label",))
+def _softmax_with_cross_entropy(ctx, op_, ins):
+    logits = jnp.asarray(ins["Logits"][0])
+    label = jnp.asarray(ins["Label"][0])
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if op_.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        idx = label.reshape(label.shape[0], -1)[:, :1].astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, idx, axis=-1)
+    return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+
+
+@op("sigmoid_cross_entropy_with_logits", infer_shape=same_as_input(),
+    non_diff_inputs=("Label",))
+def _sigmoid_ce(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    label = jnp.asarray(ins["Label"][0])
+    # max(x,0) - x*z + log(1+exp(-|x|)) — stable form
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": [loss]}
+
+
+# --- simple losses ----------------------------------------------------------
+
+@op("smooth_l1_loss", non_diff_inputs=("Y",))
+def _smooth_l1(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    y = jnp.asarray(ins["Y"][0])
+    sigma2 = op_.attr("sigma", 1.0) ** 2
+    d = x - y
+    if ins.get("InsideWeight") and ins["InsideWeight"][0] is not None:
+        d = d * jnp.asarray(ins["InsideWeight"][0])
+    ad = jnp.abs(d)
+    diff = jnp.where(ad < 1.0 / sigma2, 0.5 * d * d * sigma2, ad - 0.5 / sigma2)
+    if ins.get("OutsideWeight") and ins["OutsideWeight"][0] is not None:
+        diff = diff * jnp.asarray(ins["OutsideWeight"][0])
+    out = jnp.sum(diff.reshape(diff.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [out], "Diff": [d]}
+
+
+@op("log_loss", non_diff_inputs=("Labels",))
+def _log_loss(ctx, op_, ins):
+    p = jnp.asarray(ins["Predicted"][0])
+    y = jnp.asarray(ins["Labels"][0])
+    eps = op_.attr("epsilon", 1e-4)
+    out = -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)
+    return {"Loss": [out]}
+
+
+@op("hinge_loss", non_diff_inputs=("Labels",))
+def _hinge_loss(ctx, op_, ins):
+    pred = jnp.asarray(ins["Logits"][0])
+    label = jnp.asarray(ins["Labels"][0])
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2 * label - 1) * pred)]}
+
+
+@op("huber_loss", non_diff_inputs=("Y",))
+def _huber_loss(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])  # predictions
+    y = jnp.asarray(ins["Y"][0])
+    delta = op_.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@op("rank_loss", non_diff_inputs=("Label",))
+def _rank_loss(ctx, op_, ins):
+    label = jnp.asarray(ins["Label"][0])
+    left = jnp.asarray(ins["Left"][0])
+    right = jnp.asarray(ins["Right"][0])
+    d = left - right
+    return {"Out": [jnp.log1p(jnp.exp(d)) - label * d]}
+
+
+@op("margin_rank_loss", non_diff_inputs=("Label",))
+def _margin_rank_loss(ctx, op_, ins):
+    label = jnp.asarray(ins["Label"][0])
+    x1 = jnp.asarray(ins["X1"][0])
+    x2 = jnp.asarray(ins["X2"][0])
+    margin = op_.attr("margin", 0.0)
+    act = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [act], "Activated": [(act > 0).astype(x1.dtype)]}
+
+
+@op("squared_l2_norm")
+def _squared_l2_norm(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    return {"Out": [jnp.sum(x * x).reshape(1)]}
+
+
+@op("squared_l2_distance", non_diff_inputs=())
+def _squared_l2_distance(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    y = jnp.asarray(ins["Y"][0])
+    sub = x - y
+    return {"Out": [jnp.sum(sub * sub, axis=1, keepdims=True)], "sub_result": [sub]}
+
+
+# --- embedding --------------------------------------------------------------
+
+def _lookup_infer(op_, block):
+    wv, iv = in_var(op_, block, "W"), in_var(op_, block, "Ids")
+    if wv is None or iv is None or wv.shape is None or iv.shape is None:
+        return
+    shape = list(iv.shape)
+    if shape and shape[-1] == 1:
+        shape = shape[:-1]
+    set_out(op_, block, "Out", shape + [wv.shape[1]], wv.dtype)
+
+
+@op("lookup_table", infer_shape=_lookup_infer, non_diff_inputs=("Ids",))
+def _lookup_table(ctx, op_, ins):
+    w = jnp.asarray(ins["W"][0])
+    ids = jnp.asarray(ins["Ids"][0])
+    squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids.reshape(ids.shape[:-1])
+    pad = op_.attr("padding_idx", -1)
+    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if pad is not None and pad >= 0:
+        out = jnp.where((ids == pad)[..., None], 0.0, out)
+    return {"Out": [out]}
+
+
+# --- conv / pool ------------------------------------------------------------
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+def _conv_out_dim(i, k, p, s, d=1):
+    if i is None or i < 0:
+        return None
+    ke = d * (k - 1) + 1
+    return (i + 2 * p - ke) // s + 1
+
+
+def _conv2d_infer(op_, block):
+    xv, fv = in_var(op_, block, "Input"), in_var(op_, block, "Filter")
+    if xv is None or fv is None or xv.shape is None or fv.shape is None:
+        return
+    s, p, d = (_pair(op_.attr("strides", [1, 1])), _pair(op_.attr("paddings", [0, 0])),
+               _pair(op_.attr("dilations", [1, 1])))
+    n, _, h, w = xv.shape
+    co, _, kh, kw = fv.shape
+    set_out(op_, block, "Output",
+            [n, co, _conv_out_dim(h, kh, p[0], s[0], d[0]),
+             _conv_out_dim(w, kw, p[1], s[1], d[1])], xv.dtype)
+
+
+@op("conv2d", infer_shape=_conv2d_infer)
+def _conv2d(ctx, op_, ins):
+    x = jnp.asarray(ins["Input"][0])
+    w = jnp.asarray(ins["Filter"][0])
+    s = _pair(op_.attr("strides", [1, 1]))
+    p = _pair(op_.attr("paddings", [0, 0]))
+    d = _pair(op_.attr("dilations", [1, 1]))
+    groups = op_.attr("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": [out]}
+
+
+@op("depthwise_conv2d", infer_shape=_conv2d_infer)
+def _depthwise_conv2d(ctx, op_, ins):
+    return _conv2d(ctx, op_, ins)
+
+
+def _conv3d_infer(op_, block):
+    xv, fv = in_var(op_, block, "Input"), in_var(op_, block, "Filter")
+    if xv is None or fv is None or xv.shape is None or fv.shape is None:
+        return
+    s = _pair(op_.attr("strides", [1, 1, 1]), 3)
+    p = _pair(op_.attr("paddings", [0, 0, 0]), 3)
+    d = _pair(op_.attr("dilations", [1, 1, 1]), 3)
+    n = xv.shape[0]
+    co = fv.shape[0]
+    dims = [_conv_out_dim(xv.shape[2 + i], fv.shape[2 + i], p[i], s[i], d[i])
+            for i in range(3)]
+    set_out(op_, block, "Output", [n, co] + dims, xv.dtype)
+
+
+@op("conv3d", infer_shape=_conv3d_infer)
+def _conv3d(ctx, op_, ins):
+    x = jnp.asarray(ins["Input"][0])
+    w = jnp.asarray(ins["Filter"][0])
+    s = _pair(op_.attr("strides", [1, 1, 1]), 3)
+    p = _pair(op_.attr("paddings", [0, 0, 0]), 3)
+    d = _pair(op_.attr("dilations", [1, 1, 1]), 3)
+    groups = op_.attr("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=s, padding=[(pi, pi) for pi in p],
+        rhs_dilation=d, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": [out]}
+
+
+def _convt2d_infer(op_, block):
+    xv, fv = in_var(op_, block, "Input"), in_var(op_, block, "Filter")
+    if xv is None or fv is None or xv.shape is None or fv.shape is None:
+        return
+    s = _pair(op_.attr("strides", [1, 1]))
+    p = _pair(op_.attr("paddings", [0, 0]))
+    d = _pair(op_.attr("dilations", [1, 1]))
+    n, _, h, w = xv.shape
+    _, co, kh, kw = fv.shape
+
+    def odim(i, k, pp, ss, dd):
+        if i is None or i < 0:
+            return None
+        return (i - 1) * ss - 2 * pp + dd * (k - 1) + 1
+    set_out(op_, block, "Output",
+            [n, co, odim(h, kh, p[0], s[0], d[0]), odim(w, kw, p[1], s[1], d[1])],
+            xv.dtype)
+
+
+@op("conv2d_transpose", infer_shape=_convt2d_infer)
+def _conv2d_transpose(ctx, op_, ins):
+    x = jnp.asarray(ins["Input"][0])
+    w = jnp.asarray(ins["Filter"][0])   # (Cin, Cout, kh, kw) = IOHW
+    s = _pair(op_.attr("strides", [1, 1]))
+    p = _pair(op_.attr("paddings", [0, 0]))
+    d = _pair(op_.attr("dilations", [1, 1]))
+    kh = d[0] * (w.shape[2] - 1) + 1
+    kw = d[1] * (w.shape[3] - 1) + 1
+    # Gradient-of-conv formulation: dilate the input by stride, pad by k-1-p.
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3)).swapaxes(0, 1),  # -> OIHW flipped
+        window_strides=(1, 1),
+        padding=[(kh - 1 - p[0], kh - 1 - p[0]), (kw - 1 - p[1], kw - 1 - p[1])],
+        lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": [out]}
+
+
+def _pool2d_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is None or xv.shape is None:
+        return
+    if op_.attr("global_pooling", False):
+        set_out(op_, block, "Out", [xv.shape[0], xv.shape[1], 1, 1], xv.dtype)
+        return
+    k = _pair(op_.attr("ksize"))
+    s = _pair(op_.attr("strides", [1, 1]))
+    p = _pair(op_.attr("paddings", [0, 0]))
+    n, c, h, w = xv.shape
+
+    def odim(i, kk, pp, ss):
+        if i is None or i < 0:
+            return None
+        if op_.attr("ceil_mode", False):
+            return (i - kk + 2 * pp + ss - 1) // ss + 1
+        return (i - kk + 2 * pp) // ss + 1
+    set_out(op_, block, "Out",
+            [n, c, odim(h, k[0], p[0], s[0]), odim(w, k[1], p[1], s[1])], xv.dtype)
+
+
+@op("pool2d", infer_shape=_pool2d_infer)
+def _pool2d(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    ptype = op_.attr("pooling_type", "max")
+    if op_.attr("global_pooling", False):
+        k = list(x.shape[2:])
+        s, p = k, [0, 0]
+    else:
+        k = _pair(op_.attr("ksize"))
+        s = _pair(op_.attr("strides", [1, 1]))
+        p = _pair(op_.attr("paddings", [0, 0]))
+    window = (1, 1, k[0], k[1])
+    strides = (1, 1, s[0], s[1])
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
+    else:
+        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+        if op_.attr("exclusive", True):
+            ones = jnp.ones(x.shape[2:], dtype=x.dtype)[None, None]
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, pads)
+            out = out / cnt
+        else:
+            out = out / (k[0] * k[1])
+    return {"Out": [out]}
+
+
+# --- normalization ----------------------------------------------------------
+
+def _bn_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is None or xv.shape is None:
+        return
+    set_out(op_, block, "Y", xv.shape, xv.dtype)
+    c = xv.shape[1] if len(xv.shape) > 1 else xv.shape[0]
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        set_out(op_, block, slot, [c], "float32")
+
+
+@op("batch_norm", infer_shape=_bn_infer,
+    non_diff_inputs=("Mean", "Variance"))
+def _batch_norm(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    scale = jnp.asarray(ins["Scale"][0])
+    bias = jnp.asarray(ins["Bias"][0])
+    mean = jnp.asarray(ins["Mean"][0])
+    var = jnp.asarray(ins["Variance"][0])
+    eps = op_.attr("epsilon", 1e-5)
+    momentum = op_.attr("momentum", 0.9)
+    is_test = op_.attr("is_test", False)
+    axes = tuple(i for i in range(x.ndim) if i != 1)
+    shape = [1] * x.ndim
+    shape[1] = x.shape[1]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.mean(jnp.square(x - use_mean.reshape(shape)), axis=axes)
+        mean_out = mean * momentum + use_mean * (1.0 - momentum)
+        var_out = var * momentum + use_var * (1.0 - momentum)
+        saved_mean = use_mean
+        saved_var = use_var
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(shape)) * (inv * scale).reshape(shape) \
+        + bias.reshape(shape)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+def _ln_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is None or xv.shape is None:
+        return
+    set_out(op_, block, "Y", xv.shape, xv.dtype)
+    ax = op_.attr("begin_norm_axis", 1)
+    left = int(np.prod([d for d in xv.shape[:ax]])) if all(
+        d is not None and d > 0 for d in xv.shape[:ax]) else None
+    set_out(op_, block, "Mean", [left] if left else None, "float32")
+    set_out(op_, block, "Variance", [left] if left else None, "float32")
+
+
+@op("layer_norm", infer_shape=_ln_infer)
+def _layer_norm(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    ax = op_.attr("begin_norm_axis", 1)
+    eps = op_.attr("epsilon", 1e-5)
+    axes = tuple(range(ax, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    feat_shape = (1,) * ax + x.shape[ax:]
+    if ins.get("Scale") and ins["Scale"][0] is not None:
+        y = y * jnp.asarray(ins["Scale"][0]).reshape(feat_shape)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        y = y + jnp.asarray(ins["Bias"][0]).reshape(feat_shape)
+    return {"Y": [y], "Mean": [mean.reshape(-1)], "Variance": [var.reshape(-1)]}
+
+
+@op("lrn", infer_shape=same_as_input())
+def _lrn(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    n = op_.attr("n", 5)
+    k = op_.attr("k", 2.0)
+    alpha = op_.attr("alpha", 1e-4)
+    beta = op_.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    acc = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add, (1, n, 1, 1), (1, 1, 1, 1),
+        ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+@op("label_smooth", non_diff_inputs=("PriorDist",))
+def _label_smooth(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    eps = op_.attr("epsilon", 0.0)
+    if ins.get("PriorDist") and ins["PriorDist"][0] is not None:
+        prior = jnp.asarray(ins["PriorDist"][0])
+        out = (1 - eps) * x + eps * prior
+    else:
+        out = (1 - eps) * x + eps / x.shape[-1]
+    return {"Out": [out]}
+
+
+# --- dropout ----------------------------------------------------------------
+
+def _dropout_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None:
+        set_out(op_, block, "Out", xv.shape, xv.dtype)
+        set_out(op_, block, "Mask", xv.shape, "float32")
+
+
+def _dropout_grad(fwd, no_grad_set):
+    xname = fwd.input("X")[0]
+    if xname in no_grad_set:
+        return []
+    return [OpDesc(
+        type="dropout_grad",
+        inputs={"Mask": fwd.output("Mask"),
+                "Out@GRAD": [grad_var_name(fwd.output("Out")[0])]},
+        outputs={"X@GRAD": [grad_var_name(xname)]},
+        attrs=dict(fwd.attrs))]
+
+
+@op("dropout", infer_shape=_dropout_infer, grad=_dropout_grad)
+def _dropout(ctx, op_, ins):
+    """Reference semantics (dropout_op.cc, 'downgrade_in_infer'): train
+    multiplies by a bernoulli mask; inference scales by (1-p)."""
+    x = jnp.asarray(ins["X"][0])
+    p = op_.attr("dropout_prob", 0.5)
+    if op_.attr("is_test", False):
+        return {"Out": [x * (1.0 - p)], "Mask": [jnp.ones_like(x)]}
+    key = ctx.next_rng(op_)
+    mask = jax.random.bernoulli(key, 1.0 - p, x.shape).astype(x.dtype)
+    return {"Out": [x * mask], "Mask": [mask]}
+
+
+@op("dropout_grad", grad=NO_GRAD)
+def _dropout_grad_kernel(ctx, op_, ins):
+    dout = jnp.asarray(ins["Out@GRAD"][0])
+    mask = jnp.asarray(ins["Mask"][0])
+    return {"X@GRAD": [dout * mask]}
+
+
+# --- metrics (no grad) ------------------------------------------------------
+
+def _accuracy_infer(op_, block):
+    set_out(op_, block, "Accuracy", [1], "float32")
+    set_out(op_, block, "Correct", [1], "int32")
+    set_out(op_, block, "Total", [1], "int32")
+
+
+@op("accuracy", infer_shape=_accuracy_infer, grad=NO_GRAD)
+def _accuracy(ctx, op_, ins):
+    idx = jnp.asarray(ins["Indices"][0])
+    label = jnp.asarray(ins["Label"][0]).reshape(-1, 1)
+    hit = jnp.any(idx == label, axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32)).reshape(1)
+    total = jnp.asarray([idx.shape[0]], dtype=jnp.int32)
+    acc = correct.astype(jnp.float32) / idx.shape[0]
+    return {"Accuracy": [acc], "Correct": [correct], "Total": [total]}
+
+
+@op("auc", grad=NO_GRAD)
+def _auc(ctx, op_, ins):
+    """Streaming-free AUC over the batch via threshold buckets
+    (reference auc_op.cc)."""
+    pred = jnp.asarray(ins["Out"][0])
+    label = jnp.asarray(ins["Label"][0]).reshape(-1)
+    pos_score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] >= 2 \
+        else pred.reshape(-1)
+    num_t = op_.attr("num_thresholds", 200)
+    th = jnp.linspace(0.0, 1.0, num_t)
+    is_pos = (label > 0)
+    tp = jnp.sum((pos_score[None, :] >= th[:, None]) & is_pos[None, :], axis=1)
+    fp = jnp.sum((pos_score[None, :] >= th[:, None]) & ~is_pos[None, :], axis=1)
+    P = jnp.maximum(jnp.sum(is_pos), 1)
+    N = jnp.maximum(jnp.sum(~is_pos), 1)
+    tpr = tp / P
+    fpr = fp / N
+    auc = -jnp.trapezoid(tpr, fpr)
+    return {"AUC": [auc.reshape(1)]}

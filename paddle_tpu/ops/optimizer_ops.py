@@ -1,0 +1,208 @@
+"""Optimizer update ops (reference: sgd_op.cc, momentum_op.cc, adam_op.cc,
+adamax_op.cc, adagrad_op.cc, decayed_adagrad_op.cc, adadelta_op.cc,
+rmsprop_op.cc, ftrl_op.cc, proximal_gd_op.cc, proximal_adagrad_op.cc).
+
+Like the reference, optimizer updates are ops in the program: outputs alias
+the parameter/accumulator input names, so under the jitted whole-block
+executor the updates fuse with the backward pass and parameters stay resident
+in HBM (buffer donation in executor.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import NO_GRAD, op
+from .common import in_var, set_out
+
+
+def _param_out_infer(*pairs):
+    def infer(op_, block):
+        for in_slot, out_slot in pairs:
+            iv = in_var(op_, block, in_slot)
+            if iv is not None:
+                set_out(op_, block, out_slot, iv.shape, iv.dtype)
+    return infer
+
+
+def _lr(ins):
+    return jnp.asarray(ins["LearningRate"][0]).reshape(())
+
+
+@op("sgd", grad=NO_GRAD, infer_shape=_param_out_infer(("Param", "ParamOut")))
+def _sgd(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    return {"ParamOut": [p - _lr(ins) * g]}
+
+
+@op("momentum", grad=NO_GRAD,
+    infer_shape=_param_out_infer(("Param", "ParamOut"),
+                                 ("Velocity", "VelocityOut")))
+def _momentum(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    v = jnp.asarray(ins["Velocity"][0])
+    mu = op_.attr("mu")
+    v_out = mu * v + g
+    if op_.attr("use_nesterov", False):
+        p_out = p - _lr(ins) * (g + mu * v_out)
+    else:
+        p_out = p - _lr(ins) * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@op("adam", grad=NO_GRAD,
+    infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment1", "Moment1Out"),
+                                 ("Moment2", "Moment2Out")))
+def _adam(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    m1 = jnp.asarray(ins["Moment1"][0])
+    m2 = jnp.asarray(ins["Moment2"][0])
+    b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
+    b2p = jnp.asarray(ins["Beta2Pow"][0]).reshape(())
+    b1 = op_.attr("beta1", 0.9)
+    b2 = op_.attr("beta2", 0.999)
+    eps = op_.attr("epsilon", 1e-8)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    po = p - lr * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": [po], "Moment1Out": [m1o], "Moment2Out": [m2o]}
+
+
+@op("adamax", grad=NO_GRAD,
+    infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut"),
+                                 ("InfNorm", "InfNormOut")))
+def _adamax(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    m = jnp.asarray(ins["Moment"][0])
+    u = jnp.asarray(ins["InfNorm"][0])
+    b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
+    b1 = op_.attr("beta1", 0.9)
+    b2 = op_.attr("beta2", 0.999)
+    eps = op_.attr("epsilon", 1e-8)
+    mo = b1 * m + (1 - b1) * g
+    uo = jnp.maximum(b2 * u, jnp.abs(g))
+    po = p - (_lr(ins) / (1 - b1p)) * mo / (uo + eps)
+    return {"ParamOut": [po], "MomentOut": [mo], "InfNormOut": [uo]}
+
+
+@op("adagrad", grad=NO_GRAD,
+    infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
+def _adagrad(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    m = jnp.asarray(ins["Moment"][0])
+    eps = op_.attr("epsilon", 1e-6)
+    mo = m + g * g
+    po = p - _lr(ins) * g / (jnp.sqrt(mo) + eps)
+    return {"ParamOut": [po], "MomentOut": [mo]}
+
+
+@op("decayed_adagrad", grad=NO_GRAD,
+    infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
+def _decayed_adagrad(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    m = jnp.asarray(ins["Moment"][0])
+    decay = op_.attr("decay", 0.95)
+    eps = op_.attr("epsilon", 1e-6)
+    mo = decay * m + (1 - decay) * g * g
+    po = p - _lr(ins) * g / (jnp.sqrt(mo) + eps)
+    return {"ParamOut": [po], "MomentOut": [mo]}
+
+
+@op("adadelta", grad=NO_GRAD,
+    infer_shape=_param_out_infer(("Param", "ParamOut"),
+                                 ("AvgSquaredGrad", "AvgSquaredGradOut"),
+                                 ("AvgSquaredUpdate", "AvgSquaredUpdateOut")))
+def _adadelta(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    ag = jnp.asarray(ins["AvgSquaredGrad"][0])
+    au = jnp.asarray(ins["AvgSquaredUpdate"][0])
+    rho = op_.attr("rho", 0.95)
+    eps = op_.attr("epsilon", 1e-6)
+    ago = rho * ag + (1 - rho) * g * g
+    upd = -jnp.sqrt((au + eps) / (ago + eps)) * g
+    auo = rho * au + (1 - rho) * upd * upd
+    return {"ParamOut": [p + upd], "AvgSquaredGradOut": [ago],
+            "AvgSquaredUpdateOut": [auo]}
+
+
+@op("rmsprop", grad=NO_GRAD,
+    infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut"),
+                                 ("MeanSquare", "MeanSquareOut")))
+def _rmsprop(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    mom = jnp.asarray(ins["Moment"][0])
+    ms = jnp.asarray(ins["MeanSquare"][0])
+    rho = op_.attr("decay", 0.9)
+    eps = op_.attr("epsilon", 1e-10)
+    mu = op_.attr("momentum", 0.0)
+    mso = rho * ms + (1 - rho) * g * g
+    momo = mu * mom + _lr(ins) * g / jnp.sqrt(mso + eps)
+    return {"ParamOut": [p - momo], "MomentOut": [momo], "MeanSquareOut": [mso]}
+
+
+@op("ftrl", grad=NO_GRAD,
+    infer_shape=_param_out_infer(("Param", "ParamOut"),
+                                 ("SquaredAccumulator", "SquaredAccumOut"),
+                                 ("LinearAccumulator", "LinearAccumOut")))
+def _ftrl(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    sq = jnp.asarray(ins["SquaredAccumulator"][0])
+    lin = jnp.asarray(ins["LinearAccumulator"][0])
+    l1 = op_.attr("l1", 0.0)
+    l2 = op_.attr("l2", 0.0)
+    power = op_.attr("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + g * g
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    new_lin = lin + g - sigma * p
+    if power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    po = pre / denom
+    return {"ParamOut": [po], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [new_lin]}
+
+
+@op("proximal_gd", grad=NO_GRAD,
+    infer_shape=_param_out_infer(("Param", "ParamOut")))
+def _proximal_gd(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    l1 = op_.attr("l1", 0.0)
+    l2 = op_.attr("l2", 0.0)
+    lr = _lr(ins)
+    prox = p - lr * g
+    po = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) \
+        / (1.0 + lr * l2)
+    return {"ParamOut": [po]}
+
+
+@op("proximal_adagrad", grad=NO_GRAD,
+    infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
+def _proximal_adagrad(ctx, op_, ins):
+    p = jnp.asarray(ins["Param"][0])
+    g = jnp.asarray(ins["Grad"][0])
+    m = jnp.asarray(ins["Moment"][0])
+    l1 = op_.attr("l1", 0.0)
+    l2 = op_.attr("l2", 0.0)
+    mo = m + g * g
+    lr = _lr(ins) / jnp.sqrt(mo + 1e-12)
+    prox = p - lr * g
+    po = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) \
+        / (1.0 + lr * l2)
+    return {"ParamOut": [po], "MomentOut": [mo]}

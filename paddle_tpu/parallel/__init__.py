@@ -1,0 +1,6 @@
+"""Parallelism: mesh-based SPMD replacing the reference's parameter-server /
+NCCL / parallel_do stack (SURVEY.md §2.5). See `mesh.py` and `transpiler.py`."""
+
+from . import mesh
+from .mesh import get_mesh, set_mesh, data_parallel_mesh
+from . import transpiler

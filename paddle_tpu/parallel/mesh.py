@@ -1,0 +1,57 @@
+"""Device-mesh management: the TPU-native replacement for the reference's
+multi-device machinery (get_places / parallel_do / NCCL Communicator /
+parameter-server endpoints — SURVEY.md §2.5).
+
+A program tagged with a mesh runs SPMD: the executor shards feeds over the
+'dp' axis and replicates parameters; XLA GSPMD inserts the grad AllReduce
+over ICI (the jax.lax.psum the north star asks for comes out of the
+partitioner rather than hand-written per-op)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_current_mesh: Optional[Mesh] = None
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def data_parallel_mesh(n_devices: Optional[int] = None,
+                       devices: Optional[Sequence] = None) -> Mesh:
+    """1-D 'dp' mesh over the first n local devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), axis_names=("dp",))
+
+
+def make_mesh(shape: Sequence[int], axis_names: Sequence[str],
+              devices: Optional[Sequence] = None) -> Mesh:
+    """N-D mesh, e.g. make_mesh((4, 2), ('dp', 'mp'))."""
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(shape))
+    return Mesh(np.array(devices[:n]).reshape(shape), axis_names=tuple(axis_names))
+
+
+def batch_sharding(mesh: Mesh, ndim: int, axis: str = "dp") -> NamedSharding:
+    spec = [None] * ndim
+    if ndim > 0:
+        spec[0] = axis
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

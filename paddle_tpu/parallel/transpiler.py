@@ -1,0 +1,63 @@
+"""DistributeTranspiler: source-compatible facade over mesh sharding.
+
+The reference rewrites the program into trainer programs (send ops) and
+parameter-server programs (listen_and_serv) over gRPC
+(reference: python/paddle/fluid/distribute_transpiler.py:134 transpile,
+:258 get_pserver_program). On TPU the whole tier collapses into synchronous
+AllReduce data parallelism over ICI: `transpile` tags the program with a
+device mesh; the executor then runs it SPMD with feeds sharded along the
+batch axis and XLA inserting the gradient AllReduce. `get_pserver_program`
+has no role (there are no parameter servers) and raises with guidance.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..framework.framework import Program, default_main_program
+from . import mesh as mesh_mod
+
+__all__ = ["DistributeTranspiler", "memory_optimize", "release_memory"]
+
+
+class DistributeTranspiler:
+    def transpile(self, trainer_id: int = 0, program: Optional[Program] = None,
+                  pservers: str = "", trainers: int = 1,
+                  split_method=None, mesh=None):
+        """Tag `program` for SPMD data-parallel execution over `trainers`
+        devices (or an explicit mesh)."""
+        self.program = program or default_main_program()
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        if mesh is None:
+            mesh = mesh_mod.data_parallel_mesh(
+                None if trainers <= 1 else trainers)
+        self.mesh = mesh
+        self.program._mesh = mesh
+        return self
+
+    def get_trainer_program(self) -> Program:
+        return self.program
+
+    def get_pserver_program(self, endpoint=None):
+        raise RuntimeError(
+            "There are no parameter servers on TPU: the transpiled program "
+            "runs synchronous AllReduce data parallelism over ICI. Run the "
+            "trainer program on every host (jax.distributed) instead.")
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        raise RuntimeError(
+            "No pserver startup program on TPU; run the normal startup "
+            "program once per host.")
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0):
+    """Reference memory_optimization_transpiler.py:332 rewrote var reuse;
+    under XLA, buffer liveness/reuse is the compiler's job, so this is a
+    documented no-op kept for source compatibility."""
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return input_program

@@ -1,0 +1,98 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py:33 cuda_profiler,
+:76 profiler; platform/profiler.cc, device_tracer.cc).
+
+On TPU the device tracer is jax.profiler (XLA/TensorBoard trace). The host
+event profiler records per-run wall times of the compiled block, mirroring
+the reference's RecordEvent aggregation table."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax
+
+__all__ = ["cuda_profiler", "profiler", "start_profiler", "stop_profiler",
+           "reset_profiler"]
+
+_events: Dict[str, List[float]] = defaultdict(list)
+_active = False
+
+
+def record_event(name: str, seconds: float):
+    if _active:
+        _events[name].append(seconds)
+
+
+@contextlib.contextmanager
+def record(name: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_event(name, time.perf_counter() - t0)
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def start_profiler(state="All", trace_dir: Optional[str] = None):
+    global _active
+    _active = True
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+    _start_trace_dir[0] = trace_dir
+
+
+_start_trace_dir = [None]
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    global _active
+    _active = False
+    if _start_trace_dir[0]:
+        jax.profiler.stop_trace()
+        _start_trace_dir[0] = None
+    _print_table(sorted_key)
+
+
+def _print_table(sorted_key=None):
+    if not _events:
+        return
+    rows = []
+    for name, times in _events.items():
+        total = sum(times)
+        rows.append((name, len(times), total, total / len(times),
+                     min(times), max(times)))
+    if sorted_key in ("total", None):
+        rows.sort(key=lambda r: -r[2])
+    elif sorted_key == "calls":
+        rows.sort(key=lambda r: -r[1])
+    elif sorted_key == "ave":
+        rows.sort(key=lambda r: -r[3])
+    print(f"{'Event':40s} {'Calls':>8s} {'Total(s)':>10s} {'Ave(s)':>10s} "
+          f"{'Min(s)':>10s} {'Max(s)':>10s}")
+    for name, calls, total, ave, mn, mx in rows:
+        print(f"{name:40s} {calls:8d} {total:10.4f} {ave:10.4f} "
+              f"{mn:10.4f} {mx:10.4f}")
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Source-compat alias: wraps an XLA trace around the block
+    (reference profiler.py:33 drove nvprof)."""
+    with profiler("All", trace_dir=output_file):
+        yield
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None,
+             trace_dir: Optional[str] = None):
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,34 @@
+"""Test harness config: run on a virtual 8-device CPU platform so sharding
+paths are exercised without TPU hardware (SURVEY.md §4.1 TPU-build
+translation)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs, scope and name generator."""
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu import executor as executor_mod
+
+    main, startup = fluid.Program(), fluid.Program()
+    old_main = fluid.switch_main_program(main)
+    old_startup = fluid.switch_startup_program(startup)
+    gen = unique_name.switch()
+    old_scope = executor_mod._scope_stack[:]
+    executor_mod._scope_stack[:] = [executor_mod.Scope()]
+    yield
+    fluid.switch_main_program(old_main)
+    fluid.switch_startup_program(old_startup)
+    unique_name.switch(gen)
+    executor_mod._scope_stack[:] = old_scope

@@ -1,0 +1,154 @@
+"""OpTest harness: single-op correctness + numeric-vs-analytic grad checks
+(reference: python/paddle/fluid/tests/unittests/op_test.py:212 OpTest,
+:97 get_numeric_gradient, :290 check_output, :378 check_grad).
+
+Subclasses set `op_type`, `inputs`, `outputs`, `attrs`. Inputs/outputs map
+slot -> ndarray, or slot -> [(name, ndarray), ...] for multi-var slots.
+check_grad builds loss = sum(mean(out) for out in output_names), runs
+append_backward, and compares the fetched analytic grads against central
+differences of the same loss.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import unique_name
+from paddle_tpu import executor as executor_mod
+
+
+def _as_pairs(slot_value, slot):
+    if isinstance(slot_value, (list, tuple)) and slot_value and \
+            isinstance(slot_value[0], (list, tuple)):
+        return [(n, np.asarray(a)) for n, a in slot_value]
+    return [(slot, np.asarray(slot_value))]
+
+
+class OpTest:
+    op_type: str = ""
+    inputs: Dict = {}
+    outputs: Dict = {}
+    attrs: Dict = {}
+
+    # --- program building ---------------------------------------------------
+    def _build(self, for_grad: Optional[Sequence[str]] = None,
+               output_names: Optional[Sequence[str]] = None,
+               no_grad_set=None):
+        main = fluid.Program()
+        startup = fluid.Program()
+        feed = {}
+        with fluid.program_guard(main, startup):
+            with unique_name.guard():
+                op_inputs = {}
+                for slot, value in self.inputs.items():
+                    names = []
+                    for name, arr in _as_pairs(value, slot):
+                        v = main.global_block().create_var(
+                            name=name, shape=list(arr.shape),
+                            dtype=arr.dtype.name, stop_gradient=False)
+                        feed[name] = arr
+                        names.append(name)
+                    op_inputs[slot] = names
+                op_outputs = {}
+                out_vars = {}
+                for slot, value in self.outputs.items():
+                    names = []
+                    for name, arr in _as_pairs(value, slot):
+                        v = main.global_block().create_var(
+                            name=name, dtype=np.asarray(arr).dtype.name)
+                        names.append(name)
+                        out_vars[name] = v
+                    op_outputs[slot] = names
+                main.global_block().append_op(
+                    type=self.op_type, inputs=op_inputs, outputs=op_outputs,
+                    attrs=dict(self.attrs))
+
+                loss = None
+                if output_names is not None:
+                    parts = []
+                    for name in output_names:
+                        m = fluid.layers.mean(
+                            fluid.layers.cast(out_vars[name], "float32"))
+                        parts.append(m)
+                    loss = parts[0]
+                    for p in parts[1:]:
+                        loss = fluid.layers.elementwise_add(loss, p)
+                    fluid.append_backward(loss, no_grad_set=no_grad_set)
+        return main, feed, out_vars, loss
+
+    def _executor(self):
+        return fluid.Executor(fluid.CPUPlace())
+
+    # --- checks -------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-4, no_check_set=()):
+        main, feed, out_vars, _ = self._build()
+        exe = self._executor()
+        scope = executor_mod.Scope()
+        with executor_mod.scope_guard(scope):
+            fetch_names = []
+            expected = []
+            for slot, value in self.outputs.items():
+                for name, arr in _as_pairs(value, slot):
+                    if name in no_check_set:
+                        continue
+                    fetch_names.append(name)
+                    expected.append(np.asarray(arr))
+            results = exe.run(main, feed=feed, fetch_list=fetch_names)
+        for name, got, want in zip(fetch_names, results, expected):
+            np.testing.assert_allclose(
+                got.astype(np.float64), want.astype(np.float64),
+                atol=atol, rtol=rtol,
+                err_msg=f"{self.op_type} output {name} mismatch")
+
+    def check_grad(self, inputs_to_check: Sequence[str],
+                   output_names, max_relative_error=0.005,
+                   numeric_delta=0.005, no_grad_set=None):
+        if isinstance(output_names, str):
+            output_names = [output_names]
+        main, feed, out_vars, loss = self._build(
+            for_grad=inputs_to_check, output_names=output_names,
+            no_grad_set=no_grad_set)
+        exe = self._executor()
+        scope = executor_mod.Scope()
+        with executor_mod.scope_guard(scope):
+            grad_names = [fluid.framework.grad_var_name(n)
+                          for n in inputs_to_check]
+            analytic = exe.run(main, feed=feed,
+                               fetch_list=[loss.name] + grad_names)
+            analytic_grads = analytic[1:]
+
+            # numeric central differences on the same compiled program
+            def run_loss(feed_dict):
+                out, = exe.run(main, feed=feed_dict,
+                               fetch_list=[loss.name])
+                return float(np.asarray(out).reshape(-1)[0])
+
+            for vname, ag in zip(inputs_to_check, analytic_grads):
+                base = feed[vname].astype(np.float64)
+                num = np.zeros_like(base, dtype=np.float64)
+                flat = base.reshape(-1)
+                for i in range(flat.size):
+                    orig = flat[i]
+                    delta = numeric_delta * max(1.0, abs(orig))
+                    f = dict(feed)
+                    pert = base.copy().reshape(-1)
+                    pert[i] = orig + delta
+                    f[vname] = pert.reshape(base.shape).astype(
+                        feed[vname].dtype)
+                    lp = run_loss(f)
+                    pert[i] = orig - delta
+                    f[vname] = pert.reshape(base.shape).astype(
+                        feed[vname].dtype)
+                    lm = run_loss(f)
+                    num.reshape(-1)[i] = (lp - lm) / (2 * delta)
+                ag = np.asarray(ag, dtype=np.float64)
+                denom = np.maximum(np.maximum(np.abs(num), np.abs(ag)), 1e-3)
+                rel = np.abs(num - ag) / denom
+                assert rel.max() <= max_relative_error, (
+                    f"{self.op_type} grad w.r.t. {vname}: max rel err "
+                    f"{rel.max():.5f} > {max_relative_error} "
+                    f"(numeric {num.reshape(-1)[:5]}, "
+                    f"analytic {ag.reshape(-1)[:5]})")

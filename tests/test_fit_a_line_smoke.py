@@ -1,0 +1,34 @@
+"""M1 smoke: linear regression end-to-end (reference book ch01
+tests/book/test_fit_a_line.py:25-70)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_fit_a_line_trains():
+    np.random.seed(0)
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+
+    sgd = fluid.optimizer.SGD(learning_rate=0.1)
+    sgd.minimize(avg_cost)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    true_w = np.random.randn(13, 1).astype(np.float32)
+    losses = []
+    for step in range(150):
+        xs = np.random.randn(32, 13).astype(np.float32)
+        ys = xs @ true_w
+        loss, = exe.run(fluid.default_main_program(),
+                        feed={"x": xs, "y": ys},
+                        fetch_list=[avg_cost])
+        losses.append(float(loss[0]))
+    assert losses[-1] < losses[0] * 0.1, f"no convergence: {losses[:3]} -> {losses[-3:]}"
+    assert losses[-1] < 0.1

@@ -1,0 +1,246 @@
+"""Op correctness + grad checks for the math op corpus
+(reference: tests/unittests/test_mul_op.py, test_elementwise_*_op.py,
+test_activation_op.py, test_reduce_op.py, test_sum_op.py …)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+class TestMulOp(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        rng = np.random.RandomState(1)
+        x = rng.uniform(-1, 1, (4, 5)).astype(np.float32)
+        y = rng.uniform(-1, 1, (5, 3)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMulOpFlatten(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        rng = np.random.RandomState(2)
+        x = rng.uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+        y = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 2, "y_num_col_dims": 1}
+        self.outputs = {"Out": (x.reshape(6, 4) @ y).reshape(2, 3, 6)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setup(self):
+        rng = np.random.RandomState(3)
+        x = rng.uniform(-1, 1, (5, 4)).astype(np.float32)
+        y = rng.uniform(-1, 1, (3, 5)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True}
+        self.outputs = {"Out": x.T @ y.T}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Y"], "Out")
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("elementwise_add", np.add), ("elementwise_sub", np.subtract),
+    ("elementwise_mul", np.multiply), ("elementwise_div", np.divide),
+    ("elementwise_max", np.maximum), ("elementwise_min", np.minimum),
+])
+def test_elementwise_same_shape(op, fn):
+    rng = np.random.RandomState(4)
+    x = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    y = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+
+    class T(OpTest):
+        pass
+    t = T()
+    t.op_type = op
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {}
+    t.outputs = {"Out": fn(x, y)}
+    t.check_output()
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+def test_elementwise_add_broadcast_axis():
+    rng = np.random.RandomState(5)
+    x = rng.uniform(-1, 1, (2, 3, 4)).astype(np.float32)
+    y = rng.uniform(-1, 1, (3,)).astype(np.float32)
+
+    class T(OpTest):
+        pass
+    t = T()
+    t.op_type = "elementwise_add"
+    t.inputs = {"X": x, "Y": y}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Out": x + y.reshape(1, 3, 1)}
+    t.check_output()
+    t.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+_ACT_CASES = {
+    "sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+    "tanh": np.tanh,
+    "relu": lambda x: np.maximum(x, 0),
+    "exp": np.exp,
+    "log": np.log,
+    "sqrt": np.sqrt,
+    "square": np.square,
+    "abs": np.abs,
+    "reciprocal": lambda x: 1 / x,
+    "softplus": lambda x: np.log1p(np.exp(x)),
+    "softsign": lambda x: x / (1 + np.abs(x)),
+}
+
+
+@pytest.mark.parametrize("act", sorted(_ACT_CASES))
+def test_activation(act):
+    rng = np.random.RandomState(6)
+    # keep away from non-differentiable points / domain edges
+    x = rng.uniform(0.2, 1.5, (3, 5)).astype(np.float32)
+
+    class T(OpTest):
+        pass
+    t = T()
+    t.op_type = act
+    t.inputs = {"X": x}
+    t.attrs = {}
+    t.outputs = {"Out": _ACT_CASES[act](x.astype(np.float64)).astype(
+        np.float32)}
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("reduce_sum", np.sum), ("reduce_mean", np.mean), ("reduce_max", np.max),
+])
+@pytest.mark.parametrize("dim,keep", [([0], False), ([1], True), (None, False)])
+def test_reduce(op, fn, dim, keep):
+    rng = np.random.RandomState(7)
+    x = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+
+    class T(OpTest):
+        pass
+    t = T()
+    t.op_type = op
+    t.inputs = {"X": x}
+    reduce_all = dim is None
+    t.attrs = {"dim": dim or [0], "keep_dim": keep, "reduce_all": reduce_all}
+    if reduce_all:
+        want = np.asarray([fn(x)])
+    else:
+        want = fn(x, axis=tuple(dim), keepdims=keep)
+    t.outputs = {"Out": want.astype(np.float32)}
+    t.check_output()
+    if op != "reduce_max":
+        t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_sum_multi_input():
+    rng = np.random.RandomState(8)
+    a = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+    b = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+    c = rng.uniform(-1, 1, (3, 4)).astype(np.float32)
+
+    class T(OpTest):
+        pass
+    t = T()
+    t.op_type = "sum"
+    t.inputs = {"X": [("x0", a), ("x1", b), ("x2", c)]}
+    t.attrs = {}
+    t.outputs = {"Out": a + b + c}
+    t.check_output()
+    t.check_grad(["x0", "x1", "x2"], "Out", max_relative_error=0.02)
+
+
+def test_mean():
+    rng = np.random.RandomState(9)
+    x = rng.uniform(-1, 1, (5, 7)).astype(np.float32)
+
+    class T(OpTest):
+        pass
+    t = T()
+    t.op_type = "mean"
+    t.inputs = {"X": x}
+    t.outputs = {"Out": np.asarray([x.mean()], dtype=np.float32)}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_concat_and_grad():
+    rng = np.random.RandomState(10)
+    a = rng.uniform(-1, 1, (2, 3)).astype(np.float32)
+    b = rng.uniform(-1, 1, (2, 5)).astype(np.float32)
+
+    class T(OpTest):
+        pass
+    t = T()
+    t.op_type = "concat"
+    t.inputs = {"X": [("a", a), ("b", b)]}
+    t.attrs = {"axis": 1}
+    t.outputs = {"Out": np.concatenate([a, b], axis=1)}
+    t.check_output()
+    t.check_grad(["a", "b"], "Out", max_relative_error=0.02)
+
+
+def test_scale():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+
+    class T(OpTest):
+        pass
+    t = T()
+    t.op_type = "scale"
+    t.inputs = {"X": x}
+    t.attrs = {"scale": 2.5, "bias": 1.0}
+    t.outputs = {"Out": x * 2.5 + 1.0}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+def test_reshape_transpose():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+
+    class TR(OpTest):
+        pass
+    t = TR()
+    t.op_type = "reshape"
+    t.inputs = {"X": x}
+    t.attrs = {"shape": [2, 12]}
+    t.outputs = {"Out": x.reshape(2, 12)}
+    t.check_output()
+    t.check_grad(["X"], "Out", max_relative_error=0.02)
+
+    t2 = TR()
+    t2.op_type = "transpose"
+    t2.inputs = {"X": x}
+    t2.attrs = {"axis": [1, 0, 2]}
+    t2.outputs = {"Out": x.transpose(1, 0, 2)}
+    t2.check_output()
+    t2.check_grad(["X"], "Out", max_relative_error=0.02)

@@ -1,0 +1,274 @@
+"""Correctness + grad checks for conv/pool/norm/softmax/loss/embedding ops
+(reference: tests/unittests/test_conv2d_op.py, test_pool2d_op.py,
+test_batch_norm_op.py, test_layer_norm_op.py, test_softmax_op.py,
+test_cross_entropy_op.py, test_lookup_table_op.py …)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _ref_conv2d(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    co, ci, kh, kw = w.shape
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, co, oh, ow), dtype=np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, axes=([1, 2, 3],
+                                                           [1, 2, 3]))
+    return out
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def setup(self, stride=1, pad=1):
+        rng = np.random.RandomState(11)
+        x = rng.uniform(-1, 1, (2, 3, 6, 6)).astype(np.float32)
+        w = rng.uniform(-1, 1, (4, 3, 3, 3)).astype(np.float32)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [stride, stride], "paddings": [pad, pad],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": _ref_conv2d(
+            x.astype(np.float64), w.astype(np.float64), stride,
+            pad).astype(np.float32)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output(atol=1e-4)
+
+    def test_output_stride2(self):
+        self.setup(stride=2, pad=0)
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.03)
+
+
+class TestPool2d(OpTest):
+    op_type = "pool2d"
+
+    def _ref_pool(self, x, k, s, ptype):
+        n, c, h, w = x.shape
+        oh = (h - k) // s + 1
+        ow = (w - k) // s + 1
+        out = np.zeros((n, c, oh, ow), dtype=x.dtype)
+        for i in range(oh):
+            for j in range(ow):
+                win = x[:, :, i * s:i * s + k, j * s:j * s + k]
+                out[:, :, i, j] = win.max((2, 3)) if ptype == "max" \
+                    else win.mean((2, 3))
+        return out
+
+    def setup(self, ptype="max"):
+        rng = np.random.RandomState(12)
+        x = rng.uniform(-1, 1, (2, 3, 6, 6)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": ptype, "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0],
+                      "global_pooling": False}
+        self.outputs = {"Out": self._ref_pool(x, 2, 2, ptype)}
+
+    def test_max(self):
+        self.setup("max")
+        self.check_output()
+
+    def test_avg(self):
+        self.setup("avg")
+        self.check_output()
+
+    def test_avg_grad(self):
+        self.setup("avg")
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def setup(self):
+        rng = np.random.RandomState(13)
+        x = rng.uniform(-2, 2, (5, 7)).astype(np.float32)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": (e / e.sum(-1, keepdims=True)).astype(
+            np.float32)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def setup(self):
+        rng = np.random.RandomState(14)
+        logits = rng.uniform(0.1, 1.0, (6, 4)).astype(np.float32)
+        probs = logits / logits.sum(-1, keepdims=True)
+        label = rng.randint(0, 4, (6, 1)).astype(np.int64)
+        loss = -np.log(probs[np.arange(6), label.ravel()]).reshape(6, 1)
+        self.inputs = {"X": probs, "Label": label}
+        self.outputs = {"Y": loss.astype(np.float32)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X"], "Y", max_relative_error=0.05,
+                        no_grad_set={"Label"})
+
+
+class TestSoftmaxWithCE(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup(self):
+        rng = np.random.RandomState(15)
+        logits = rng.uniform(-2, 2, (6, 5)).astype(np.float32)
+        label = rng.randint(0, 5, (6, 1)).astype(np.int64)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(6), label.ravel()]).reshape(6, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm.astype(np.float32),
+                        "Loss": loss.astype(np.float32)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["Logits"], "Loss", max_relative_error=0.02,
+                        no_grad_set={"Label"})
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def setup(self):
+        rng = np.random.RandomState(16)
+        w = rng.uniform(-1, 1, (10, 4)).astype(np.float32)
+        ids = rng.randint(0, 10, (5, 1)).astype(np.int64)
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {}
+        self.outputs = {"Out": w[ids.ravel()]}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["W"], "Out", max_relative_error=0.02,
+                        no_grad_set={"Ids"})
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+
+    def setup(self):
+        rng = np.random.RandomState(17)
+        x = rng.uniform(-1, 1, (3, 4, 2, 2)).astype(np.float32)
+        scale = rng.uniform(0.5, 1.5, (4,)).astype(np.float32)
+        bias = rng.uniform(-0.3, 0.3, (4,)).astype(np.float32)
+        mean = np.zeros(4, np.float32)
+        var = np.ones(4, np.float32)
+        eps, mom = 1e-5, 0.9
+        bm = x.mean((0, 2, 3))
+        bv = x.var((0, 2, 3))
+        y = (x - bm.reshape(1, 4, 1, 1)) / np.sqrt(
+            bv.reshape(1, 4, 1, 1) + eps) * scale.reshape(1, 4, 1, 1) \
+            + bias.reshape(1, 4, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"epsilon": eps, "momentum": mom, "is_test": False}
+        self.outputs = {
+            "Y": y.astype(np.float32),
+            "MeanOut": (mean * mom + bm * (1 - mom)).astype(np.float32),
+            "VarianceOut": (var * mom + bv * (1 - mom)).astype(np.float32),
+            "SavedMean": bm.astype(np.float32),
+            "SavedVariance": bv.astype(np.float32),
+        }
+
+    def test_output(self):
+        self.setup()
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.05,
+                        no_grad_set={"Mean", "Variance"})
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def setup(self):
+        rng = np.random.RandomState(18)
+        x = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+        scale = rng.uniform(0.5, 1.5, (6,)).astype(np.float32)
+        bias = rng.uniform(-0.3, 0.3, (6,)).astype(np.float32)
+        eps = 1e-5
+        mean = x.mean(1, keepdims=True)
+        var = x.var(1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps, "begin_norm_axis": 1}
+        self.outputs = {"Y": y.astype(np.float32),
+                        "Mean": mean.ravel().astype(np.float32),
+                        "Variance": var.ravel().astype(np.float32)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.05)
+
+
+class TestTopKAccuracy(OpTest):
+    op_type = "top_k"
+
+    def test_output(self):
+        rng = np.random.RandomState(19)
+        x = rng.uniform(-1, 1, (4, 6)).astype(np.float32)
+        k = 2
+        idx = np.argsort(-x, axis=1)[:, :k]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"k": k}
+        self.outputs = {"Out": vals, "Indices": idx.astype(np.int64)}
+        self.check_output()
+
+
+def test_dropout_train_eval():
+    import paddle_tpu as fluid
+    x = fluid.layers.data(name="x", shape=[100], dtype="float32")
+    out_train = fluid.layers.dropout(x, dropout_prob=0.3, is_test=False)
+    out_eval = fluid.layers.dropout(x, dropout_prob=0.3, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = np.ones((10, 100), np.float32)
+    tr, ev = exe.run(fluid.default_main_program(), feed={"x": xs},
+                     fetch_list=[out_train, out_eval])
+    # eval mode scales by (1-p); train mode zeroes ~p of entries
+    np.testing.assert_allclose(ev, xs * 0.7, rtol=1e-6)
+    frac_zero = (tr == 0).mean()
+    assert 0.15 < frac_zero < 0.45
+    assert set(np.unique(tr)) <= {0.0, 1.0}
