@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Flagship benchmark: ResNet-50 training throughput (images/sec) on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor: the reference's best published ResNet-50 training number,
+81.69 images/sec (train bs64, MKL-DNN, 2x Xeon 6148 — see BASELINE.md §4;
+the reference publishes no GPU ResNet-50 number). vs_baseline = value/81.69.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 81.69
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data(name="img", shape=[3, 224, 224],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        avg_cost, _, _ = models.build_image_classifier(
+            models.resnet50, img, label, class_dim=1000)
+        opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        opt.minimize(avg_cost, startup_program=startup)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((BATCH, 3, 224, 224), dtype=np.float32)
+    y = rng.integers(0, 1000, (BATCH, 1)).astype(np.int64)
+    # Stage the batch in HBM once: the benchmark measures compute throughput,
+    # not host link bandwidth (the real input pipeline double-buffers).
+    import jax
+    feed = {"img": jax.device_put(x, exe.device),
+            "label": jax.device_put(y, exe.device)}
+
+    for _ in range(max(WARMUP, 1)):
+        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+    float(np.asarray(loss).ravel()[0])  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+    float(np.asarray(loss).ravel()[0])  # sync on the last step
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -194,6 +194,22 @@ class LoweringContext:
         self.place = executor.place
         self._rng_key = rng_key
         self.lod_map = lod_map    # var name -> lod metadata (host-side)
+        # live env of the block being traced; lowerings use it to read
+        # sequence-length side channels (`<var>@SEQLEN`, see seq_len()).
+        self.env: Dict[str, Any] = {}
+        # out var name -> lengths array (or None to clear) set by sequence
+        # lowerings to override the default SEQLEN propagation in _exec_op
+        self.seq_overrides: Dict[str, Any] = {}
+
+    def seq_len(self, name: str):
+        """Per-sequence valid lengths [batch] for a padded sequence var, or
+        None. The TPU-native stand-in for the reference's LoD offset table
+        (lod_tensor.h:55): LoDTensor feeds are padded dense and their lengths
+        ride along the trace as an int32 array input."""
+        return self.env.get(name + SEQLEN_SUFFIX)
+
+    def set_seq_len(self, name: str, lengths):
+        self.seq_overrides[name] = lengths
 
     def next_rng(self, op=None):
         """Deterministic per-op PRNG key. Keyed on the op's first output name
@@ -228,6 +244,49 @@ class LoweringContext:
 _EAGER = os.environ.get("PADDLE_TPU_EAGER", "0") == "1"
 _CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
 
+SEQLEN_SUFFIX = "@SEQLEN"
+
+
+def _bucket_len(n: int) -> int:
+    """Round a max sequence length up to a bucket boundary so XLA sees a
+    small set of static shapes instead of one per batch (SURVEY.md §7:
+    'bucketing + dense speed'): powers of two up to 64, multiples of 64 after."""
+    if n <= 8:
+        return 8
+    b = 8
+    while b < n and b < 64:
+        b *= 2
+    return b if b >= n else ((n + 63) // 64) * 64
+
+
+def pack_to_padded(flat: np.ndarray, lod: List[List[int]]):
+    """Packed [sum_len, ...] rows + level-1 LoD offsets -> padded
+    [batch, T, ...] plus int32 lengths [batch]. The dense/padded layout is
+    the XLA-friendly equivalent of the reference's zero-padding-free packed
+    LoDTensor (lod_tensor.h:107)."""
+    assert len(lod) == 1, (
+        "only lod_level==1 feeds are supported (nested sequences: pad "
+        "outer level host-side before feeding)")
+    offs = lod[0]
+    lengths = np.asarray([b - a for a, b in zip(offs[:-1], offs[1:])],
+                         dtype=np.int32)
+    bsz = len(lengths)
+    t = _bucket_len(int(lengths.max()) if bsz else 1)
+    padded = np.zeros((bsz, t) + tuple(flat.shape[1:]), dtype=flat.dtype)
+    for i, (a, b) in enumerate(zip(offs[:-1], offs[1:])):
+        padded[i, : b - a] = flat[a:b]
+    return padded, lengths
+
+
+def padded_to_pack(padded: np.ndarray, lengths: np.ndarray):
+    """Inverse of pack_to_padded: padded [B,T,...] + lengths -> packed rows +
+    LoD offsets (for fetch-side LoDTensor reconstruction)."""
+    rows = [padded[i, : int(l)] for i, l in enumerate(lengths)]
+    offs = [0]
+    for r in rows:
+        offs.append(offs[-1] + len(r))
+    return (np.concatenate(rows, axis=0) if rows else padded[:0, 0]), [offs]
+
 
 class _CompiledBlock:
     def __init__(self, fn, state_names, feed_names, fetch_names, program):
@@ -260,14 +319,21 @@ class Executor:
                        for v in fetch_list]
         jit_mode = (not _EAGER) if use_jit is None else use_jit
 
-        # Normalize feeds: LoDTensor → array (+ lod metadata), numpy asarray.
+        # Normalize feeds. LoDTensor feeds with a LoD become padded dense
+        # arrays plus a `<name>@SEQLEN` lengths input (pack_to_padded) — the
+        # XLA-friendly LoD emulation; plain arrays pass through.
         feed_vals, lod_map = {}, {}
         for name, val in feed.items():
             if isinstance(val, LoDTensor):
                 lod_map[name] = val.lod
-                val = val.array()
-            feed_vals[name] = np.asarray(val) if not isinstance(
-                val, jax.Array) else val
+                arr = np.asarray(val.array())
+                if val.lod:
+                    arr, lengths = pack_to_padded(arr, val.lod)
+                    feed_vals[name + SEQLEN_SUFFIX] = lengths
+                feed_vals[name] = arr
+            else:
+                feed_vals[name] = np.asarray(val) if not isinstance(
+                    val, jax.Array) else val
 
         block = program.global_block()
         state_names = self._external_inputs(program, block, set(feed_vals), scope)
@@ -284,7 +350,12 @@ class Executor:
             v = scope.find_var(n)
             if isinstance(v, LoDTensor):
                 lod_map[n] = v.lod
-                v = v.array()
+                arr = np.asarray(v.array())
+                if v.lod:
+                    # same padded+SEQLEN convention as LoD feeds
+                    arr, lengths = pack_to_padded(arr, v.lod)
+                    state_vals[n + SEQLEN_SUFFIX] = lengths
+                v = arr
             state_vals[n] = v
 
         rng_counter = scope.find_var("__rng_counter__") or 0
@@ -292,28 +363,56 @@ class Executor:
         rng_key = jax.random.fold_in(jax.random.key(seed), rng_counter)
         scope.set_var("__rng_counter__", rng_counter + 1)
 
+        state_keys = sorted(state_vals)  # incl. @SEQLEN side channels
         if jit_mode:
             key = (id(program), getattr(program, "_version", 0),
                    tuple(sorted(feed_vals)), tuple(fetch_names),
-                   tuple(state_names), self.place)
+                   tuple(state_keys), self.place)
             compiled = self._cache.get(key) if use_program_cache else None
             if compiled is None:
-                compiled = self._compile(program, state_names, sorted(feed_vals),
+                compiled = self._compile(program, state_keys, sorted(feed_vals),
                                          fetch_names, persist_out, lod_map)
                 if use_program_cache:
                     self._cache[key] = compiled
             with jax.default_device(self.device):
-                fetch_vals, new_state = compiled.fn(feed_vals, state_vals, rng_key)
+                fetch_vals, fetch_lens, new_state = compiled.fn(
+                    feed_vals, state_vals, rng_key)
         else:
-            fetch_vals, new_state = self._run_eager(
+            fetch_vals, fetch_lens, new_state = self._run_eager(
                 program, feed_vals, state_vals, fetch_names, persist_out,
                 rng_key, lod_map)
 
         for n, v in new_state.items():
-            scope.set_var(n, v)
-        if return_numpy:
-            fetch_vals = [np.asarray(v) for v in fetch_vals]
-        return fetch_vals
+            if n.endswith(SEQLEN_SUFFIX):
+                continue
+            if n + SEQLEN_SUFFIX in new_state:
+                # sequence state goes back to the scope as a LoDTensor so the
+                # next run re-packs it with its lengths intact
+                packed, lod = padded_to_pack(
+                    np.asarray(v), np.asarray(new_state[n + SEQLEN_SUFFIX]))
+                scope.set_var(n, LoDTensor(packed, lod))
+            else:
+                scope.set_var(n, v)
+        # Fetched sequence vars come back in the reference's packed layout
+        # ([sum_len, ...] rows): numpy mode returns the packed array, LoDTensor
+        # mode additionally carries the offsets.
+        rebuilt = []
+        for n, v in zip(fetch_names, fetch_vals):
+            lens = fetch_lens.get(n)
+            arr = np.asarray(v)
+            if lens is not None:
+                lens = np.asarray(lens)
+                # ignore spuriously-tagged non-sequence fetches
+                if arr.ndim < 2 or lens.shape[0] != arr.shape[0] or \
+                        (lens.size and lens.max() > arr.shape[1]):
+                    lens = None
+            if lens is not None:
+                packed, lod = padded_to_pack(arr, lens)
+                rebuilt.append(np.asarray(packed) if return_numpy
+                               else LoDTensor(packed, lod))
+            else:
+                rebuilt.append(arr if return_numpy else v)
+        return rebuilt
 
     def close(self):
         self._cache.clear()
@@ -375,14 +474,38 @@ class Executor:
             return
         opdef = registry.get(op.type)
         assert opdef.lower is not None, f"op '{op.type}' has no lowering"
+        prev_env = ctx.env
+        ctx.env = env
+        ctx.seq_overrides = {}
         ins = {slot: [env.get(n) for n in names]
                for slot, names in op.desc.inputs.items()}
         outs = opdef.lower(ctx, op, ins)
+        # Default SEQLEN propagation mirrors the reference's LoD propagation
+        # (most ops share LoD with their first sequence input); sequence
+        # lowerings override via ctx.set_seq_len.
+        inherited = None
+        for names in op.desc.inputs.values():
+            for n in names:
+                if n + SEQLEN_SUFFIX in env:
+                    inherited = env[n + SEQLEN_SUFFIX]
+                    break
+            if inherited is not None:
+                break
         for slot, names in op.desc.outputs.items():
             vals = outs.get(slot, [])
             for name, val in zip(names, vals):
                 if val is not None:
                     env[name] = val
+                    if name in ctx.seq_overrides:
+                        sl = ctx.seq_overrides[name]
+                        if sl is None:
+                            env.pop(name + SEQLEN_SUFFIX, None)
+                        else:
+                            env[name + SEQLEN_SUFFIX] = sl
+                    elif inherited is not None and hasattr(val, "ndim") \
+                            and getattr(val, "ndim", 0) >= 2:
+                        env[name + SEQLEN_SUFFIX] = inherited
+        ctx.env = prev_env
 
     def _trace_block(self, program, feed_vals, state_vals, fetch_names,
                      persist_out, rng_key, lod_map):
@@ -394,15 +517,31 @@ class Executor:
         for op in block.ops:
             self._exec_op(ctx, op, env)
         fetch = [env[n] for n in fetch_names]
+        # lengths side channel for fetched sequence vars, so run() can
+        # rebuild LoDTensors (padded_to_pack) when return_numpy=False
+        fetch_lens = {n: env[n + SEQLEN_SUFFIX] for n in fetch_names
+                      if n + SEQLEN_SUFFIX in env}
         new_state = {n: env[n] for n in persist_out if n in env}
         # state read but never written flows through unchanged
         for n in state_vals:
-            if n not in new_state:
+            if n not in new_state and not n.endswith(SEQLEN_SUFFIX):
                 for b in program.blocks:
                     if b.desc.has_var(n) and b.desc.var(n).persistable:
                         new_state[n] = env[n]
                         break
-        return fetch, new_state
+        # lengths side channels for sequence-state write-back — only for vars
+        # *declared* as sequences (lod_level>0): the default SEQLEN
+        # propagation in _exec_op can spuriously tag non-sequence outputs
+        # (e.g. a parameter updated from a sequence-derived gradient)
+        for n in list(new_state):
+            if n + SEQLEN_SUFFIX not in env:
+                continue
+            for b in program.blocks:
+                if b.desc.has_var(n):
+                    if b.desc.var(n).lod_level > 0:
+                        new_state[n + SEQLEN_SUFFIX] = env[n + SEQLEN_SUFFIX]
+                    break
+        return fetch, fetch_lens, new_state
 
     def _compile(self, program, state_names, feed_names, fetch_names,
                  persist_out, lod_map) -> _CompiledBlock:
@@ -450,11 +589,23 @@ class Executor:
                             raise FloatingPointError(
                                 f"NaN/Inf in output '{name}' of op {op.type}")
         fetch = [env[n] for n in fetch_names]
+        fetch_lens = {n: env[n + SEQLEN_SUFFIX] for n in fetch_names
+                      if n + SEQLEN_SUFFIX in env}
         new_state = {}
         for n in set(persist_out) | set(state_vals):
+            if n.endswith(SEQLEN_SUFFIX):
+                continue
             if n in env:
                 for b in program.blocks:
                     if b.desc.has_var(n) and b.desc.var(n).persistable:
                         new_state[n] = env[n]
                         break
-        return fetch, new_state
+        for n in list(new_state):
+            if n + SEQLEN_SUFFIX not in env:
+                continue
+            for b in program.blocks:
+                if b.desc.has_var(n):
+                    if b.desc.var(n).lod_level > 0:
+                        new_state[n + SEQLEN_SUFFIX] = env[n + SEQLEN_SUFFIX]
+                    break
+        return fetch, fetch_lens, new_state

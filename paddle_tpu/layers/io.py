@@ -14,6 +14,11 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
     a -1 batch dim is prepended; the executor binds actual shapes at run."""
     helper_block = default_main_program().current_block()
     shape = list(shape)
+    if lod_level and lod_level > 0:
+        # padded-LoD convention (executor.pack_to_padded): sequence feeds are
+        # dense [batch, time, ...features], vs the reference's packed
+        # [sum_len, ...features]; one -1 time dim per lod level
+        shape = [-1] * lod_level + shape
     if append_batch_size:
         shape = [-1] + shape
     var = helper_block.create_var(name=name, shape=shape, dtype=dtype,

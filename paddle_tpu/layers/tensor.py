@@ -51,7 +51,7 @@ def cast(x, dtype):
 
 
 def concat(input, axis=0, name=None):
-    helper = LayerHelper("concat", name=name)
+    helper = LayerHelper("concat", input=input, name=name)
     out = helper.create_tmp_variable(dtype=helper.input_dtype())
     helper.append_op(type="concat", inputs={"X": input},
                      outputs={"Out": [out]}, attrs={"axis": axis})
@@ -59,7 +59,7 @@ def concat(input, axis=0, name=None):
 
 
 def sums(input, out=None):
-    helper = LayerHelper("sum")
+    helper = LayerHelper("sum", input=input)
     if out is None:
         out = helper.create_tmp_variable(dtype=helper.input_dtype())
     helper.append_op(type="sum", inputs={"X": input}, outputs={"Out": [out]})

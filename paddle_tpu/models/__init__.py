@@ -1,0 +1,20 @@
+"""Model zoo: the reference's benchmark + book models, built on the layers DSL.
+
+Reference model scripts: benchmark/paddle/image/{alexnet,googlenet,resnet,vgg,
+smallnet_mnist_cifar}.py and python/paddle/fluid/tests/book/*. Each builder
+takes the input Variable(s) and returns logits/prediction Variables; training
+glue (loss, optimizer) stays in user code or in `build_classifier`.
+"""
+
+from .alexnet import alexnet
+from .googlenet import googlenet
+from .mnist import mnist_conv, mnist_mlp
+from .resnet import resnet_cifar10, resnet_imagenet, resnet50
+from .vgg import vgg16, vgg19
+from .common import build_image_classifier
+
+__all__ = [
+    "alexnet", "googlenet", "mnist_conv", "mnist_mlp",
+    "resnet_cifar10", "resnet_imagenet", "resnet50", "vgg16", "vgg19",
+    "build_image_classifier",
+]

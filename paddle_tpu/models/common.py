@@ -1,0 +1,17 @@
+"""Shared model-building glue for the zoo."""
+
+from __future__ import annotations
+
+from .. import layers
+
+
+def build_image_classifier(model_fn, images, label, class_dim=1000, **kwargs):
+    """Attach softmax-cross-entropy classification head + accuracy to a
+    backbone (the pattern every reference benchmark script repeats,
+    e.g. benchmark/paddle/image/resnet.py)."""
+    logits = model_fn(images, class_dim=class_dim, **kwargs)
+    cost = layers.softmax_with_cross_entropy(logits=logits, label=label)
+    avg_cost = layers.mean(cost)
+    predict = layers.softmax(logits)
+    acc = layers.accuracy(input=predict, label=label)
+    return avg_cost, predict, acc

@@ -6,5 +6,6 @@ from . import math_ops       # noqa: F401
 from . import nn_ops         # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import extra_ops      # noqa: F401
+from . import sequence_ops   # noqa: F401
 
 from .registry import register, op, get, try_get, registered_ops, NO_GRAD

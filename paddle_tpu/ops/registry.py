@@ -175,6 +175,10 @@ def generic_grad_lower(ctx, op, ins):
                 diff_paths.append((slot, i))
 
     out_slots_order = sorted(fwd_out_slots)
+    # (slot, idx) for each value fwd_fn actually returns — a lowering may
+    # produce fewer outputs than the op declares (e.g. sequence_pool's
+    # MaxIndex); populated during the eager vjp trace below
+    out_spec: List = []
 
     def fwd_fn(diff_vals):
         local = {s: list(vs) for s, vs in fwd_ins.items()}
@@ -182,26 +186,28 @@ def generic_grad_lower(ctx, op, ins):
             local[slot][i] = v
         outs = fwd_def.lower(ctx, fwd_op_view, local)
         flat = []
+        out_spec.clear()
         for s in out_slots_order:
-            flat.extend(outs.get(s, []))
+            for j, v in enumerate(outs.get(s, [])):
+                flat.append(v)
+                out_spec.append((s, j))
         return flat
 
     primals = [fwd_ins[s][i] for s, i in diff_paths]
     out_vals, vjp_fn = jax.vjp(fwd_fn, primals)
 
-    # Cotangents, ordered to match fwd_fn's flat output.
+    # Cotangents matched to fwd_fn's actual flat output.
     cts = []
-    k = 0
-    for s in out_slots_order:
-        gnames = op.desc.inputs.get(s + "@GRAD", [])
-        n_out = len(fwd_desc.outputs.get(s, []))
+    for ov, (s, j) in zip(out_vals, out_spec):
+        ov = jnp.asarray(ov)
         gvals = ins.get(s + "@GRAD", [])
-        for j in range(n_out):
-            ov = out_vals[k]; k += 1
-            if j < len(gvals) and gvals[j] is not None:
-                cts.append(jnp.asarray(gvals[j], dtype=jnp.result_type(ov)))
-            else:
-                cts.append(jnp.zeros_like(ov))
+        if not jnp.issubdtype(ov.dtype, jnp.inexact):
+            # integer/bool outputs carry no gradient signal
+            cts.append(np.zeros(ov.shape, dtype=jax.dtypes.float0))
+        elif j < len(gvals) and gvals[j] is not None:
+            cts.append(jnp.asarray(gvals[j], dtype=ov.dtype))
+        else:
+            cts.append(jnp.zeros_like(ov))
     (grads,) = vjp_fn(cts)
 
     outs: Dict[str, List[Any]] = {}

@@ -1,0 +1,294 @@
+"""Sequence-op correctness on the padded-LoD convention: outputs compared
+against per-sequence numpy references, plus gradient sanity via end-to-end
+convergence through lax.scan (reference test models:
+tests/unittests/test_lstm_op.py, test_gru_op.py, test_seq_pool.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu.executor import LoDTensor
+
+
+def make_lod(rows):
+    """rows: list of [len_i, D] arrays -> packed LoDTensor."""
+    flat = np.concatenate(rows, axis=0)
+    offs = [0]
+    for r in rows:
+        offs.append(offs[-1] + len(r))
+    return LoDTensor(flat, [offs])
+
+
+def run_prog(feed, fetch, return_numpy=True):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(fluid.default_main_program(), feed=feed,
+                   fetch_list=fetch, return_numpy=return_numpy)
+
+
+RNG = np.random.RandomState(7)
+
+
+class TestSequencePool:
+    def _run(self, pool_type):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        out = fluid.layers.sequence_pool(x, pool_type)
+        rows = [RNG.randn(n, 3).astype(np.float32) for n in (2, 5, 1)]
+        res, = run_prog({"x": make_lod(rows)}, [out])
+        return rows, res
+
+    def test_sum(self):
+        rows, res = self._run("sum")
+        want = np.stack([r.sum(0) for r in rows])
+        np.testing.assert_allclose(res, want, rtol=1e-5)
+
+    def test_average(self):
+        rows, res = self._run("average")
+        want = np.stack([r.mean(0) for r in rows])
+        np.testing.assert_allclose(res, want, rtol=1e-5)
+
+    def test_sqrt(self):
+        rows, res = self._run("sqrt")
+        want = np.stack([r.sum(0) / np.sqrt(len(r)) for r in rows])
+        np.testing.assert_allclose(res, want, rtol=1e-5)
+
+    def test_max(self):
+        rows, res = self._run("max")
+        want = np.stack([r.max(0) for r in rows])
+        np.testing.assert_allclose(res, want, rtol=1e-5)
+
+    def test_first_last(self):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        first = fluid.layers.sequence_first_step(x)
+        last = fluid.layers.sequence_last_step(x)
+        rows = [RNG.randn(n, 3).astype(np.float32) for n in (2, 5, 1)]
+        f, l = run_prog({"x": make_lod(rows)}, [first, last])
+        np.testing.assert_allclose(f, np.stack([r[0] for r in rows]), rtol=1e-5)
+        np.testing.assert_allclose(l, np.stack([r[-1] for r in rows]), rtol=1e-5)
+
+
+class TestSequenceSoftmax:
+    def test_masked(self):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                              lod_level=1)
+        out = fluid.layers.sequence_softmax(x)
+        rows = [RNG.randn(n, 1).astype(np.float32) for n in (3, 6)]
+        # fetched sequence vars come back packed ([sum_len, 1], reference
+        # layout)
+        res, = run_prog({"x": make_lod(rows)}, [out])
+        off = 0
+        for r in rows:
+            e = np.exp(r[:, 0] - r[:, 0].max())
+            want = e / e.sum()
+            np.testing.assert_allclose(res[off: off + len(r), 0], want,
+                                       rtol=1e-5)
+            off += len(r)
+        assert res.shape[0] == off
+
+
+def _np_lstm(x_rows, w, b, h_dim, peep):
+    """Per-sequence numpy LSTM matching ops/sequence_ops.py gate layout
+    [i, f, c~, o]."""
+    outs = []
+    b_gate = b[: 4 * h_dim]
+    for seq in x_rows:
+        h = np.zeros(h_dim, np.float64)
+        c = np.zeros(h_dim, np.float64)
+        hs = []
+        for xt in seq.astype(np.float64):
+            g = xt + h @ w.astype(np.float64) + b_gate
+            gi, gf, gc, go = np.split(g, 4)
+            if peep:
+                gi = gi + c * b[4 * h_dim: 5 * h_dim]
+                gf = gf + c * b[5 * h_dim: 6 * h_dim]
+            i = 1 / (1 + np.exp(-gi))
+            f = 1 / (1 + np.exp(-gf))
+            c = f * c + i * np.tanh(gc)
+            if peep:
+                go = go + c * b[6 * h_dim: 7 * h_dim]
+            o = 1 / (1 + np.exp(-go))
+            h = o * np.tanh(c)
+            hs.append(h.copy())
+        outs.append(np.stack(hs))
+    return outs
+
+
+class TestDynamicLSTM:
+    @pytest.mark.parametrize("peep", [False, True])
+    def test_vs_numpy(self, peep):
+        h_dim = 4
+        x = fluid.layers.data(name="x", shape=[4 * h_dim], dtype="float32",
+                              lod_level=1)
+        hidden, cell = fluid.layers.dynamic_lstm(
+            input=x, size=4 * h_dim, use_peepholes=peep)
+        rows = [RNG.randn(n, 4 * h_dim).astype(np.float32) for n in (3, 5)]
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        scope = executor_mod.global_scope()
+        # pull the startup-initialized weights for the numpy reference
+        params = fluid.default_main_program().global_block().all_parameters()
+        by_shape = {tuple(p.shape): np.asarray(scope.find_var(p.name))
+                    for p in params}
+        w = by_shape[(h_dim, 4 * h_dim)]
+        bias_width = 7 * h_dim if peep else 4 * h_dim
+        b = by_shape[(1, bias_width)].reshape(-1).astype(np.float64)
+        # randomize bias so peepholes actually bite
+        b = RNG.randn(bias_width).astype(np.float32).astype(np.float64) * 0.3
+        bias_name = [p.name for p in params
+                     if tuple(p.shape) == (1, bias_width)][0]
+        scope.set_var(bias_name, b.astype(np.float32).reshape(1, -1))
+
+        res, = exe.run(fluid.default_main_program(),
+                       feed={"x": make_lod(rows)}, fetch_list=[hidden])
+        want = _np_lstm(rows, w, b, h_dim, peep)
+        off = 0
+        for wseq in want:
+            np.testing.assert_allclose(res[off: off + len(wseq)], wseq,
+                                       rtol=1e-4, atol=1e-5)
+            off += len(wseq)
+        assert res.shape[0] == off
+
+
+class TestDynamicGRU:
+    def test_vs_numpy(self):
+        h_dim = 3
+        x = fluid.layers.data(name="x", shape=[3 * h_dim], dtype="float32",
+                              lod_level=1)
+        hidden = fluid.layers.dynamic_gru(input=x, size=h_dim)
+        rows = [RNG.randn(n, 3 * h_dim).astype(np.float32) for n in (2, 4)]
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        scope = executor_mod.global_scope()
+        params = fluid.default_main_program().global_block().all_parameters()
+        w = np.asarray(scope.find_var(
+            [p.name for p in params if tuple(p.shape) == (h_dim, 3 * h_dim)][0]
+        )).astype(np.float64)
+        res, = exe.run(fluid.default_main_program(),
+                       feed={"x": make_lod(rows)}, fetch_list=[hidden])
+        off = 0
+        for seq in rows:
+            h = np.zeros(h_dim, np.float64)
+            for t_, xt in enumerate(seq.astype(np.float64)):
+                ur = 1 / (1 + np.exp(-(xt[: 2 * h_dim]
+                                       + h @ w[:, : 2 * h_dim])))
+                u, r = ur[:h_dim], ur[h_dim:]
+                c = np.tanh(xt[2 * h_dim:] + (r * h) @ w[:, 2 * h_dim:])
+                h = u * h + (1 - u) * c
+                np.testing.assert_allclose(res[off + t_], h, rtol=1e-4,
+                                           atol=1e-5)
+            off += len(seq)
+
+
+class TestSequenceExpandConcat:
+    def test_expand(self):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32",
+                              lod_level=1)
+        out = fluid.layers.sequence_expand(x=x, y=y)
+        xv = RNG.randn(2, 2).astype(np.float32)
+        yrows = [RNG.randn(n, 1).astype(np.float32) for n in (2, 3)]
+        res, = run_prog({"x": xv, "y": make_lod(yrows)}, [out],
+                        return_numpy=False)
+        assert res.recursive_sequence_lengths()[0] == [2, 3]
+        arr = np.asarray(res.array())
+        assert np.all(arr[:2] == xv[0])
+        assert np.all(arr[2:] == xv[1])
+
+    def test_concat(self):
+        a = fluid.layers.data(name="a", shape=[2], dtype="float32",
+                              lod_level=1)
+        b = fluid.layers.data(name="b", shape=[2], dtype="float32",
+                              lod_level=1)
+        out = fluid.layers.sequence_concat(input=[a, b])
+        arows = [RNG.randn(n, 2).astype(np.float32) for n in (2, 1)]
+        brows = [RNG.randn(n, 2).astype(np.float32) for n in (1, 3)]
+        res, = run_prog({"a": make_lod(arows), "b": make_lod(brows)}, [out],
+                        return_numpy=False)
+        assert isinstance(res, LoDTensor)
+        want_rows = [np.concatenate([x, y]) for x, y in zip(arows, brows)]
+        got_lens = res.recursive_sequence_lengths()[0]
+        assert got_lens == [3, 4]
+        np.testing.assert_allclose(
+            np.asarray(res.array()), np.concatenate(want_rows), rtol=1e-5)
+
+
+class TestSequenceMisc:
+    def test_slice(self):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                              lod_level=1)
+        off = fluid.layers.data(name="off", shape=[1], dtype="int32")
+        ln = fluid.layers.data(name="ln", shape=[1], dtype="int32")
+        out = fluid.layers.sequence_slice(x, off, ln)
+        rows = [np.arange(5, dtype=np.float32).reshape(5, 1),
+                np.arange(10, 14, dtype=np.float32).reshape(4, 1)]
+        res, = run_prog({"x": make_lod(rows),
+                         "off": np.array([[1], [0]], np.int32),
+                         "ln": np.array([[2], [3]], np.int32)}, [out],
+                        return_numpy=False)
+        assert res.recursive_sequence_lengths()[0] == [2, 3]
+        np.testing.assert_allclose(np.asarray(res.array())[:, 0],
+                                   [1, 2, 10, 11, 12])
+
+    def test_erase(self):
+        x = fluid.layers.data(name="x", shape=[1], dtype="int64",
+                              lod_level=1)
+        out = fluid.layers.sequence_erase(x, tokens=[2, 5])
+        rows = [np.array([[1], [2], [3], [5]], np.int64),
+                np.array([[2], [2], [7]], np.int64)]
+        res, = run_prog({"x": make_lod(rows)}, [out], return_numpy=False)
+        lens = res.recursive_sequence_lengths()[0]
+        assert lens == [2, 1]
+        arr = np.asarray(res.array()).reshape(-1)
+        np.testing.assert_array_equal(arr, [1, 3, 7])
+
+    def test_reshape(self):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                              lod_level=1)
+        out = fluid.layers.sequence_reshape(x, new_dim=2)
+        rows = [RNG.randn(2, 4).astype(np.float32)]
+        res, = run_prog({"x": make_lod(rows)}, [out], return_numpy=False)
+        assert res.recursive_sequence_lengths()[0] == [4]
+        np.testing.assert_allclose(np.asarray(res.array()),
+                                   rows[0].reshape(4, 2), rtol=1e-6)
+
+
+class TestLSTMTrains:
+    def test_convergence(self):
+        """Gradients flow through the scan: tiny sequence classifier must
+        converge (label = 1 iff mean of sequence values > 0)."""
+        h = 16
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32",
+                              lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        proj = fluid.layers.fc(input=x, size=4 * h, num_flatten_dims=2)
+        hidden, _ = fluid.layers.dynamic_lstm(input=proj, size=4 * h,
+                                              use_peepholes=False)
+        pooled = fluid.layers.sequence_pool(hidden, "last")
+        logits = fluid.layers.fc(input=pooled, size=2)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            logits=logits, label=label))
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        losses = []
+        for step in range(60):
+            rows, labs = [], []
+            for _ in range(16):
+                n = rng.randint(2, 7)
+                bias = rng.choice([-0.5, 0.5])
+                r = (rng.randn(n, 8) * 0.3 + bias).astype(np.float32)
+                rows.append(r)
+                labs.append([int(r.mean() > 0)])
+            l, = exe.run(fluid.default_main_program(),
+                         feed={"x": make_lod(rows),
+                               "label": np.asarray(labs, np.int64)},
+                         fetch_list=[loss])
+            losses.append(float(np.ravel(l)[0]))
+        assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.6, losses
