@@ -33,6 +33,10 @@ from . import clip
 from .data_feeder import DataFeeder
 from . import io
 from . import nets
+from . import models
+from . import reader
+from . import dataset
+from .minibatch import batch
 from . import parallel
 from . import profiler
 from . import metrics

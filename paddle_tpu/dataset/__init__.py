@@ -1,0 +1,10 @@
+"""Datasets (reference: python/paddle/v2/dataset/ — 14 loaders with
+download+cache). Zero-egress build: each module serves a deterministic
+synthetic surrogate with the real schema unless real files are present
+under common.DATA_HOME (see common.py)."""
+
+from . import (cifar, common, conll05, imdb, imikolov, mnist, movielens,
+               uci_housing, wmt14)
+
+__all__ = ["cifar", "common", "conll05", "imdb", "imikolov", "mnist",
+           "movielens", "uci_housing", "wmt14"]

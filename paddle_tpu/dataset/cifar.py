@@ -1,0 +1,46 @@
+"""CIFAR-10/100 (reference: python/paddle/v2/dataset/cifar.py). Schema:
+3*32*32 float32 in [0,1], int64 label. Synthetic surrogate: class-colored
+quadrant blobs."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_TRAIN_N, _TEST_N = 4096, 512
+
+
+def _synthetic(n, classes, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, classes, n)
+    imgs = rng.rand(n, 3, 32, 32).astype(np.float32) * 0.25
+    for k in range(n):
+        c = int(labels[k])
+        ch = c % 3
+        q = (c // 3) % 4
+        r0, c0 = (q // 2) * 16, (q % 2) * 16
+        imgs[k, ch, r0:r0 + 16, c0:c0 + 16] += 0.7
+    return np.clip(imgs, 0, 1).reshape(n, 3 * 32 * 32), labels.astype(np.int64)
+
+
+def _reader(n, classes, seed):
+    def reader():
+        imgs, labels = _synthetic(n, classes, seed)
+        for i in range(n):
+            yield imgs[i], int(labels[i])
+    return reader
+
+
+def train10():
+    return _reader(_TRAIN_N, 10, 0)
+
+
+def test10():
+    return _reader(_TEST_N, 10, 1)
+
+
+def train100():
+    return _reader(_TRAIN_N, 100, 2)
+
+
+def test100():
+    return _reader(_TEST_N, 100, 3)

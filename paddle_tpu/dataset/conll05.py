@@ -1,0 +1,53 @@
+"""CoNLL-2005 SRL (reference: python/paddle/v2/dataset/conll05.py, used by
+the label_semantic_roles book chapter). Schema per sample: 8 parallel
+variable-length int64 sequences (word, predicate, ctx_n2..ctx_p2, mark)
+plus the IOB label sequence. Synthetic surrogate ties labels to word ids."""
+
+from __future__ import annotations
+
+import numpy as np
+
+WORD_VOCAB = 44068
+PRED_VOCAB = 3162
+MARK_VOCAB = 2
+LABEL_N = 59
+
+_TRAIN_N, _TEST_N = 1024, 128
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
+    verb_dict = {f"v{i}": i for i in range(PRED_VOCAB)}
+    label_dict = {f"l{i}": i for i in range(LABEL_N)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    raise RuntimeError("pretrained emb unavailable without egress; "
+                       "initialize embeddings randomly instead")
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            ln = int(rng.randint(4, 20))
+            words = rng.randint(0, 2000, ln)
+            pred_id = int(rng.randint(0, PRED_VOCAB))
+            pred = np.full(ln, pred_id)
+            ctxs = [np.roll(words, k) for k in (-2, -1, 0, 1, 2)]
+            mark = (rng.rand(ln) < 0.2).astype(np.int64)
+            labels = (words * 7 + pred_id) % LABEL_N  # learnable mapping
+            yield (words.tolist(), pred.tolist(),
+                   ctxs[0].tolist(), ctxs[1].tolist(), ctxs[2].tolist(),
+                   ctxs[3].tolist(), ctxs[4].tolist(), mark.tolist(),
+                   labels.tolist())
+    return reader
+
+
+def test():
+    return _reader(_TEST_N, 1)
+
+
+def train():
+    return _reader(_TRAIN_N, 0)

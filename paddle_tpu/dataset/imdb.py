@@ -1,0 +1,35 @@
+"""IMDB sentiment (reference: python/paddle/v2/dataset/imdb.py). Schema:
+variable-length int64 word-id sequences + binary label. Synthetic
+surrogate: two disjoint vocab regions by sentiment."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_VOCAB = 5147  # reference word_dict size ballpark
+_TRAIN_N, _TEST_N = 2048, 256
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            ln = int(rng.randint(8, 60))
+            lo = 2 + label * (_VOCAB // 2)
+            hi = lo + _VOCAB // 2 - 2
+            words = rng.randint(lo, hi, ln).tolist()
+            yield words, label
+    return reader
+
+
+def train(word_idx=None):
+    return _reader(_TRAIN_N, 0)
+
+
+def test(word_idx=None):
+    return _reader(_TEST_N, 1)

@@ -1,0 +1,36 @@
+"""PTB language-model n-grams (reference: python/paddle/v2/dataset/
+imikolov.py, used by the word2vec book chapter). Schema: n-gram of int64
+word ids. Synthetic surrogate: a Markov-ish id chain so the n-gram
+prediction task is learnable."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_VOCAB = 2074
+_TRAIN_N, _TEST_N = 4096, 512
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(n_samples, n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_samples):
+            # active ids restricted to a subset so each id recurs often
+            # enough for the n-gram task to be learnable in a short budget
+            start = int(rng.randint(0, 256))
+            # deterministic successor chain => learnable next-word task
+            gram = [(start + 7 * k) % _VOCAB for k in range(n)]
+            yield tuple(gram)
+    return reader
+
+
+def train(word_idx=None, n=5):
+    return _reader(_TRAIN_N, n, 0)
+
+
+def test(word_idx=None, n=5):
+    return _reader(_TEST_N, n, 1)

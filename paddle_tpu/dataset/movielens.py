@@ -1,0 +1,71 @@
+"""MovieLens-1M (reference: python/paddle/v2/dataset/movielens.py, used by
+the recommender_system book chapter). Schema per sample:
+(user_id, gender_id, age_id, job_id, movie_id, category_ids[var],
+ title_ids[var], score). Synthetic surrogate keeps the reference's id
+spaces and makes score a learnable function of the ids."""
+
+from __future__ import annotations
+
+import numpy as np
+
+USER_N = 6040
+MOVIE_N = 3952
+GENDER_N = 2
+AGE_N = 7
+JOB_N = 21
+CATEGORY_N = 18
+TITLE_VOCAB = 5175
+
+_TRAIN_N, _TEST_N = 4096, 512
+
+
+def max_user_id():
+    return USER_N
+
+
+def max_movie_id():
+    return MOVIE_N
+
+
+def max_job_id():
+    return JOB_N - 1
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def movie_categories():
+    return {f"cat{i}": i for i in range(CATEGORY_N)}
+
+
+def get_movie_title_dict():
+    return {f"t{i}": i for i in range(TITLE_VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            uid = int(rng.randint(1, USER_N + 1))
+            gender = int(rng.randint(0, GENDER_N))
+            age = int(rng.randint(0, AGE_N))
+            job = int(rng.randint(0, JOB_N))
+            mid = int(rng.randint(1, MOVIE_N + 1))
+            ncat = int(rng.randint(1, 4))
+            cats = rng.randint(0, CATEGORY_N, ncat).tolist()
+            ntit = int(rng.randint(1, 6))
+            titles = rng.randint(0, TITLE_VOCAB, ntit).tolist()
+            # learnable score: smooth function of user/movie ids
+            score = 1 + ((uid * 31 + mid * 17) % 5)
+            yield [uid], [gender], [age], [job], [mid], cats, titles, \
+                [float(score)]
+    return reader
+
+
+def train():
+    return _reader(_TRAIN_N, 0)
+
+
+def test():
+    return _reader(_TEST_N, 1)

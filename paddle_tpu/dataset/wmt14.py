@@ -1,0 +1,54 @@
+"""WMT-14 fr-en (reference: python/paddle/v2/dataset/wmt14.py, used by the
+machine_translation book chapter). Schema: (src_ids, trg_ids_with_<s>,
+trg_ids_next_with_<e>) variable-length int64 sequences. Synthetic
+surrogate: target = elementwise function of source, so seq2seq+attention
+can learn it."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_START, _END, _UNK = 0, 1, 2
+
+
+def _default_dict(size):
+    d = {"<s>": _START, "<e>": _END, "<unk>": _UNK}
+    for i in range(3, size):
+        d[f"w{i}"] = i
+    return d
+
+
+_TRAIN_N, _TEST_N = 2048, 256
+
+
+def _reader(n, dict_size, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            ln = int(rng.randint(3, 12))
+            src = rng.randint(3, dict_size, ln).tolist()
+            # target = deterministic chain seeded by the source head: the
+            # step-to-step rule is learnable via teacher forcing, the seed
+            # via the encoder/attention path
+            trg = [(src[0] * 3 + 1) % (dict_size - 3) + 3]
+            for _k in range(ln - 1):
+                trg.append((trg[-1] * 5 + 7) % (dict_size - 3) + 3)
+            yield src, [_START] + trg, trg + [_END]
+    return reader
+
+
+def train(dict_size):
+    return _reader(_TRAIN_N, dict_size, 0)
+
+
+def test(dict_size):
+    return _reader(_TEST_N, dict_size, 1)
+
+
+def get_dict(dict_size, reverse=False):
+    src = _default_dict(dict_size)
+    trg = _default_dict(dict_size)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

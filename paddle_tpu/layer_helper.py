@@ -46,8 +46,12 @@ class LayerHelper:
         if len(attr) != 1 and len(attr) != length:
             raise ValueError("parameter number mismatch")
         if len(attr) == 1 and length != 1:
-            attr = [attr[0]] + [ParamAttr(**attr[0].to_kwargs())
-                                for _ in range(length - 1)]
+            def clone(a):
+                import copy
+                c = copy.copy(a)
+                c.name = None  # each replica gets its own generated name
+                return c
+            attr = [attr[0]] + [clone(attr[0]) for _ in range(length - 1)]
         return attr
 
     def iter_inputs_and_params(self, input_param_name="input"):

@@ -5,8 +5,10 @@ from .io import *            # noqa: F401,F403
 from .tensor import *        # noqa: F401,F403
 from .nn import *            # noqa: F401,F403
 from .sequence import *      # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
 from . import ops as _ops_module
 from .ops import *           # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 
-from . import io, tensor, nn, ops, learning_rate_scheduler, sequence  # noqa: F401
+from . import (io, tensor, nn, ops, learning_rate_scheduler, sequence,  # noqa: F401
+               control_flow)

@@ -116,7 +116,15 @@ def square_error_cost(input, label):
     return square_out
 
 
-def softmax_with_cross_entropy(logits, label, soft_label=False):
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               seq_mask=False):
+    """Padded-sequence logits are masked automatically via the SEQLEN side
+    channel. seq_mask=True additionally asserts the logits ARE a sequence
+    (lod/rank-3), catching silent no-mask situations at build time."""
+    if seq_mask:
+        assert logits.shape is not None and len(logits.shape) >= 3, (
+            "seq_mask=True but logits are not sequence-shaped [B,T,V]; "
+            "feed the sequence through LoD data vars so lengths ride along")
     helper = LayerHelper("softmax_with_cross_entropy")
     softmax = helper.create_tmp_variable(dtype=logits.dtype)
     loss = helper.create_tmp_variable(dtype=logits.dtype)

@@ -177,7 +177,8 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                      attrs={"contextStride": filter_stride,
                             "contextStart": -int(filter_size // 2),
                             "contextLength": filter_size})
-    pre_act = helper.append_bias_op(pre_bias)
+    # output is [B, T, M]: bias over the feature dim only
+    pre_act = helper.append_bias_op(pre_bias, dim_start=2)
     return helper.append_activation(pre_act)
 
 

@@ -7,5 +7,6 @@ from . import nn_ops         # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import extra_ops      # noqa: F401
 from . import sequence_ops   # noqa: F401
+from . import control_flow_ops  # noqa: F401
 
 from .registry import register, op, get, try_get, registered_ops, NO_GRAD

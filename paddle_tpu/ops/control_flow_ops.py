@@ -1,0 +1,313 @@
+"""Control-flow ops: while / conditional_block / rnn / tensor arrays.
+
+TPU-native equivalents of the reference's scope-mutating control flow
+(reference: paddle/fluid/operators/while_op.cc:35,96,
+conditional_block_op.cc, recurrent_op.cc:222 + StepScopes :53,
+tensor_array_read_write_op.cc, lod_rank_table.cc, shrink_rnn_memory_op.cc).
+The reference interprets sub-blocks against child scopes; here each
+sub-block lowers into the parent XLA computation as
+`lax.while_loop` / `lax.cond` / `lax.scan` with explicit carries — the
+functionalized form of the reference's step scopes.
+
+LoDTensorArray: the reference grows arrays dynamically per step. XLA needs
+static shapes, so a TensorArray is a fixed-capacity buffer + a length
+scalar; writes are `dynamic_update_index` at traced indices. Capacity is
+taken from the first pre-loop write or the `capacity` attr.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..framework.desc import BlockRef
+from .common import in_var, set_out
+from .registry import NO_GRAD, op
+
+
+class TensorArrayVal:
+    """Fixed-capacity tensor array: buffer [cap, ...] + length scalar."""
+
+    def __init__(self, buffer, length):
+        self.buffer = buffer
+        self.length = length
+
+    def __repr__(self):
+        return f"TensorArrayVal(cap={self.buffer.shape[0]}, len={self.length})"
+
+
+def _ta_flatten(ta):
+    return (ta.buffer, ta.length), None
+
+
+def _ta_unflatten(aux, children):
+    return TensorArrayVal(*children)
+
+
+jax.tree_util.register_pytree_node(TensorArrayVal, _ta_flatten, _ta_unflatten)
+
+DEFAULT_ARRAY_CAPACITY = 128
+
+
+def _scalar_i32(x):
+    return jnp.asarray(x).reshape(()).astype(jnp.int32)
+
+
+@op("write_to_array", grad=NO_GRAD)
+def _write_to_array(ctx, op_, ins):
+    """array[i] = x (reference tensor_array_read_write_op.cc WriteToArray).
+    Out aliases the input array var; growing past the current buffer
+    allocates capacity (only legal outside lax control flow)."""
+    x = jnp.asarray(ins["X"][0])
+    i = _scalar_i32(ins["I"][0])
+    arr = ins.get("Out", [None])[0]
+    if arr is None or not isinstance(arr, TensorArrayVal):
+        cap = int(op_.attr("capacity", DEFAULT_ARRAY_CAPACITY))
+        buf = jnp.zeros((cap,) + x.shape, x.dtype)
+        arr = TensorArrayVal(buf, _scalar_i32(0))
+    buf = lax.dynamic_update_index_in_dim(arr.buffer, x, i, axis=0)
+    length = jnp.maximum(arr.length, i + 1)
+    return {"Out": [TensorArrayVal(buf, length)]}
+
+
+@op("read_from_array", grad=NO_GRAD)
+def _read_from_array(ctx, op_, ins):
+    arr = ins["X"][0]
+    assert isinstance(arr, TensorArrayVal), "read_from_array needs an array"
+    i = _scalar_i32(ins["I"][0])
+    return {"Out": [lax.dynamic_index_in_dim(arr.buffer, i, axis=0,
+                                             keepdims=False)]}
+
+
+@op("lod_array_length", grad=NO_GRAD)
+def _lod_array_length(ctx, op_, ins):
+    arr = ins["X"][0]
+    assert isinstance(arr, TensorArrayVal)
+    return {"Out": [arr.length.reshape(1).astype(jnp.int64)]}
+
+
+def _block_writes(program, block_idx) -> List[str]:
+    """All var names written by a block (recursively through sub-blocks)."""
+    writes: List[str] = []
+    seen = set()
+    block = program.block(block_idx)
+    for o in block.ops:
+        for name in o.output_arg_names:
+            if name not in seen:
+                seen.add(name)
+                writes.append(name)
+        for a in o.desc.attrs.values():
+            if isinstance(a, BlockRef):
+                for name in _block_writes(program, a.idx):
+                    if name not in seen:
+                        seen.add(name)
+                        writes.append(name)
+    return writes
+
+
+@op("while", grad=NO_GRAD, no_kernel=True)
+def _while(ctx, op_, ins):
+    """while(Condition) { sub_block } (reference while_op.cc:35).
+
+    Carries = every var the sub-block writes that already has a value in the
+    outer env (loop state must be initialized before the loop), plus the
+    condition var. Everything else the sub-block reads is closed over.
+    """
+    program = ctx.program
+    sub = op_.attr("sub_block")
+    assert isinstance(sub, BlockRef)
+    cond_name = op_.desc.inputs["Condition"][0]
+
+    writes = _block_writes(program, sub.idx)
+    carry_names = [n for n in writes if n in ctx.env]
+    if cond_name not in carry_names:
+        carry_names.append(cond_name)
+    outer_env = ctx.env
+    base_env = dict(outer_env)
+
+    def cond_fn(carry):
+        return jnp.asarray(carry[cond_name]).reshape(()).astype(bool)
+
+    def body_fn(carry):
+        env2 = dict(base_env)
+        env2.update(carry)
+        ctx.run_block(sub.idx, env2)
+        return {n: env2[n] for n in carry_names}
+
+    init = {n: outer_env[n] for n in carry_names}
+    final = lax.while_loop(cond_fn, body_fn, init)
+    out_names = op_.desc.outputs.get("Out", [])
+    return {"Out": [final.get(n) for n in out_names]}
+
+
+@op("conditional_block", grad=NO_GRAD, no_kernel=True)
+def _conditional_block(ctx, op_, ins):
+    """if(cond) { sub_block } (reference conditional_block_op.cc). Vars the
+    sub-block writes must either pre-exist in the outer env (else-branch
+    keeps them) or they default to zeros shaped like the then-branch
+    result."""
+    program = ctx.program
+    sub = op_.attr("sub_block")
+    cond = ins["Cond"][0]
+    is_scalar_condition = bool(op_.attr("is_scalar_condition", True))
+    pred = jnp.asarray(cond).reshape(-1)[0].astype(bool) \
+        if is_scalar_condition else jnp.all(jnp.asarray(cond))
+
+    out_names = op_.desc.outputs.get("Out", [])
+    outer_env = ctx.env
+    base_env = dict(outer_env)
+
+    def then_fn(carry):
+        env2 = dict(base_env)
+        env2.update(carry)
+        ctx.run_block(sub.idx, env2)
+        return [env2[n] for n in out_names]
+
+    # seed carry with pre-existing values; for fresh vars, use zeros shaped
+    # like the then-branch output (jax.eval_shape avoids running it)
+    carry = {n: outer_env[n] for n in out_names if n in outer_env}
+    missing = [n for n in out_names if n not in carry]
+    if missing:
+        shapes = jax.eval_shape(then_fn, carry)
+        for n, sd in zip(out_names, shapes):
+            if n in missing:
+                carry[n] = jnp.zeros(sd.shape, sd.dtype)
+
+    def else_fn(c):
+        return [c[n] for n in out_names]
+
+    outs = lax.cond(pred, then_fn, else_fn, carry)
+    return {"Out": list(outs)}
+
+
+@op("rnn", no_kernel=True)
+def _rnn(ctx, op_, ins):
+    """Step-scoped RNN over padded sequences (reference recurrent_op.cc:222;
+    the TPU lowering is a single lax.scan over the time axis).
+
+    inputs:  Inputs  — sequence vars [B, T, ...] sliced per step
+             InitStates — initial state values (one per state var)
+    attrs:   sub_block; step_input_vars / state_vars / state_out_vars /
+             step_output_vars — block-local var names; with_mask
+    outputs: Outputs — stacked per-step outputs [B, T, ...]
+             FinalStates — state after the last valid step
+    """
+    program = ctx.program
+    sub = op_.attr("sub_block")
+    step_in_names = list(op_.attr("step_input_vars", []))
+    state_names = list(op_.attr("state_vars", []))
+    state_out_names = list(op_.attr("state_out_vars", []))
+    out_names = list(op_.attr("step_output_vars", []))
+    is_reverse = bool(op_.attr("is_reverse", False))
+
+    seqs = [jnp.asarray(v) for v in ins.get("Inputs", [])]
+    states = [jnp.asarray(v) for v in ins.get("InitStates", [])]
+    assert seqs, "rnn op needs at least one sequence input"
+    bsz, t = seqs[0].shape[0], seqs[0].shape[1]
+
+    lengths = None
+    for n in op_.desc.inputs.get("Inputs", []):
+        lengths = ctx.seq_len(n)
+        if lengths is not None:
+            break
+    if lengths is not None:
+        steps = jnp.arange(t)[None, :]
+        mask = (steps < jnp.asarray(lengths)[:, None]).astype(seqs[0].dtype)
+    else:
+        mask = jnp.ones((bsz, t), seqs[0].dtype)
+
+    xs = [jnp.swapaxes(s, 0, 1) for s in seqs]          # [T, B, ...]
+    ms = jnp.swapaxes(mask, 0, 1)                        # [T, B]
+    if is_reverse:
+        xs = [x[::-1] for x in xs]
+        ms = ms[::-1]
+
+    outer_env = ctx.env
+    base_env = dict(outer_env)
+    # outer reads as explicit inputs (differentiable; see DSL) override the
+    # closure values so vjp sees them as primals
+    extra_names = list(op_.attr("extra_in_vars", []))
+    extra_vals = ins.get("ExtraIn", [])
+
+    def step(carry, inp):
+        xts, mt = inp
+        env2 = dict(base_env)
+        env2.update({n: v for n, v in zip(extra_names, extra_vals)
+                     if v is not None})
+        env2.update(dict(zip(step_in_names, xts)))
+        env2.update(dict(zip(state_names, carry)))
+        ctx.run_block(sub.idx, env2)
+        new_states = [env2[n] for n in state_out_names]
+        outs = [env2[n] for n in out_names]
+        mexp = [mt.reshape((bsz,) + (1,) * (jnp.asarray(s).ndim - 1))
+                for s in new_states]
+        kept = [m * s + (1 - m) * c for m, s, c in
+                zip(mexp, new_states, carry)]
+        omask = [mt.reshape((bsz,) + (1,) * (jnp.asarray(o).ndim - 1)) * o
+                 for o in outs]
+        return kept, omask
+
+    final_states, stacked = lax.scan(step, states, (xs, ms))
+    if is_reverse:
+        stacked = [s[::-1] for s in stacked]
+    outputs = [jnp.swapaxes(s, 0, 1) for s in stacked]
+    for name in op_.desc.outputs.get("Outputs", []):
+        ctx.set_seq_len(name, lengths)
+    for name in op_.desc.outputs.get("FinalStates", []):
+        ctx.set_seq_len(name, None)
+    return {"Outputs": outputs, "FinalStates": final_states}
+
+
+@op("select_rows_by_cond", non_diff_inputs=("Cond",))
+def _select_rows_by_cond(ctx, op_, ins):
+    """Row-wise select for the dense IfElse lowering: out[i] = cond[i] ?
+    x[i] : y[i] (the reference scatters rows into true/false sub-blocks,
+    ifelse_op.cc; evaluating both branches and selecting is the
+    branch-free TPU equivalent)."""
+    cond = jnp.asarray(ins["Cond"][0]).reshape(-1).astype(bool)
+    x = jnp.asarray(ins["X"][0])
+    y = jnp.asarray(ins["Y"][0])
+    c = cond.reshape((cond.shape[0],) + (1,) * (x.ndim - 1))
+    return {"Out": [jnp.where(c, x, y)]}
+
+
+@op("max_sequence_len", grad=NO_GRAD)
+def _max_sequence_len(ctx, op_, ins):
+    """Max length over a sequence batch (reference max_sequence_len_op.cc,
+    fed from a rank table; here straight from the lengths channel)."""
+    name = op_.desc.inputs["RankTable"][0]
+    lengths = ctx.seq_len(name)
+    if lengths is None:
+        x = jnp.asarray(ins["RankTable"][0])
+        return {"Out": [jnp.asarray(x.shape[1], jnp.int64).reshape(1)]}
+    return {"Out": [jnp.max(jnp.asarray(lengths)).astype(jnp.int64).reshape(1)]}
+
+
+@op("lod_rank_table", grad=NO_GRAD)
+def _lod_rank_table(ctx, op_, ins):
+    """The reference builds a (index, length) table sorted by length desc
+    (lod_rank_table.cc) to drive batch-shrinking RNNs. The padded lowering
+    keeps batches dense+masked, so the 'table' is just the lengths vector;
+    ops that consume it (max_sequence_len) read the SEQLEN channel."""
+    name = op_.desc.inputs["X"][0]
+    lengths = ctx.seq_len(name)
+    x = jnp.asarray(ins["X"][0])
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    out = jnp.asarray(lengths).astype(jnp.int32)
+    for n in op_.desc.outputs.get("Out", []):
+        ctx.set_seq_len(n, out)
+    return {"Out": [out]}
+
+
+@op("shrink_rnn_memory", grad=None)
+def _shrink_rnn_memory(ctx, op_, ins):
+    """The reference shrinks the RNN state batch to sequences still alive at
+    step I (shrink_rnn_memory_op.cc). Dense+masked batches keep full size,
+    so this passes the state through unchanged; masking in the rnn/scan
+    lowering supplies the same semantics."""
+    return {"Out": [jnp.asarray(ins["X"][0])]}

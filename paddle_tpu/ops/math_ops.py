@@ -243,6 +243,19 @@ def _mean_infer(op_, block):
 @op("mean", infer_shape=_mean_infer)
 def _mean(ctx, op_, ins):
     x = jnp.asarray(ins["X"][0])
+    lengths = ctx.seq_len(op_.desc.inputs["X"][0])
+    if lengths is not None and x.ndim >= 2:
+        # padded sequence: mean over valid positions only — matches the
+        # reference's mean over packed [sum_len, ...] rows
+        t = x.shape[1]
+        mask = (jnp.arange(t)[None, :] <
+                jnp.asarray(lengths)[:, None]).astype(x.dtype)
+        m = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+        feat = 1
+        for d in x.shape[2:]:
+            feat *= d
+        denom = jnp.maximum(mask.sum() * feat, 1.0)
+        return {"Out": [(jnp.sum(x * m) / denom).reshape(1)]}
     return {"Out": [jnp.mean(x).reshape(1)]}
 
 

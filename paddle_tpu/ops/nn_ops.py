@@ -64,8 +64,19 @@ def _softmax_with_cross_entropy(ctx, op_, ins):
     if op_.attr("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:
-        idx = label.reshape(label.shape[0], -1)[:, :1].astype(jnp.int32)
+        idx = label.astype(jnp.int32)
+        if idx.ndim < logits.ndim:
+            idx = idx[..., None]
+        elif idx.shape[-1] != 1:
+            idx = idx[..., :1]
         loss = -jnp.take_along_axis(logp, idx, axis=-1)
+    # padded sequence logits [B,T,V]: zero the padded positions' losses
+    lengths = ctx.seq_len(op_.desc.inputs["Logits"][0])
+    if lengths is not None and logits.ndim >= 3:
+        t = logits.shape[1]
+        mask = (jnp.arange(t)[None, :] <
+                jnp.asarray(lengths)[:, None]).astype(loss.dtype)
+        loss = loss * mask.reshape(mask.shape + (1,) * (loss.ndim - 2))
     return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
 
 

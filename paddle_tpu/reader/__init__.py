@@ -1,0 +1,190 @@
+"""Reader decorators (reference: python/paddle/v2/reader/decorator.py:29-337
+map_readers/shuffle/chain/compose/buffered/firstn/xmap_readers).
+
+A reader is a zero-arg callable returning an iterable of samples. Decorators
+wrap readers into new readers — the host-side input pipeline that feeds the
+device double-buffer."""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+from typing import Callable, Iterable
+
+__all__ = [
+    "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
+    "xmap_readers", "ComposeNotAligned",
+]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def map_readers(func, *readers):
+    """Apply func to the items of each reader, zipped (reference :29)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer (reference :59)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers (reference :90)."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    """Zip readers into tuple samples (reference :121)."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            # reference semantics: raise if readers end at different times
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            # discard trailing unaligned outputs
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` samples on a worker thread (reference :165) —
+    overlaps host data prep with device steps."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q: queue.Queue = queue.Queue(maxsize=size)
+        err = []
+
+        def fill():
+            try:
+                for d in r:
+                    q.put(d)
+            except BaseException as e:  # surfaced in the consumer
+                err.append(e)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+        if err:
+            raise err[0]
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """First n samples (reference :213)."""
+
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads (reference :237)."""
+
+    end = object()
+
+    def data_reader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    break
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        if order:
+            pending = {}
+            want = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                i, mapped = item
+                pending[i] = mapped
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+
+    return data_reader

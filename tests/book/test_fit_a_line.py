@@ -1,0 +1,54 @@
+"""Book ch01: linear regression (reference tests/book/test_fit_a_line.py):
+train on uci_housing until loss threshold, save inference model, reload it
+into a fresh scope and check predictions match."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+
+
+def test_fit_a_line_book():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    y_predict = fluid.layers.fc(input=x, size=1, act=None)
+    cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    train_reader = fluid.batch(
+        fluid.reader.shuffle(fluid.dataset.uci_housing.train(), buf_size=500),
+        batch_size=20)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+    exe.run(fluid.default_startup_program())
+
+    last = None
+    for pass_id in range(12):
+        for data in train_reader():
+            loss, = exe.run(fluid.default_main_program(),
+                            feed=feeder.feed(data), fetch_list=[avg_cost])
+            last = float(np.ravel(loss)[0])
+        if last < 0.3:
+            break
+    assert last is not None and last < 1.0, f"loss did not drop: {last}"
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "fit_a_line.model")
+        fluid.io.save_inference_model(path, ["x"], [y_predict], exe)
+
+        scope = executor_mod.Scope()
+        with executor_mod.scope_guard(scope):
+            infer_exe = fluid.Executor(place)
+            prog, feed_names, fetch_targets = \
+                fluid.io.load_inference_model(path, infer_exe)
+            xs = np.random.RandomState(0).randn(8, 13).astype(np.float32)
+            results, = infer_exe.run(prog, feed={feed_names[0]: xs},
+                                     fetch_list=fetch_targets)
+        assert results.shape == (8, 1)
+        assert np.isfinite(results).all()

@@ -1,0 +1,37 @@
+"""Book ch03: CIFAR-10 image classification, VGG + ResNet variants
+(reference tests/book/test_image_classification.py). Loss must drop on the
+synthetic surrogate within a short budget."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+
+
+@pytest.mark.parametrize("net", ["resnet", "vgg"])
+def test_image_classification(net):
+    img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    model_fn = models.resnet_cifar10 if net == "resnet" else models.vgg16
+    avg_cost, predict, acc = models.build_image_classifier(
+        model_fn, img, label, class_dim=10)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+
+    train_reader = fluid.batch(
+        fluid.reader.shuffle(fluid.dataset.cifar.train10(), buf_size=512),
+        batch_size=32)
+    place = fluid.TPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for i, data in enumerate(train_reader()):
+        data = [(np.reshape(im, (3, 32, 32)), l) for im, l in data]
+        loss, a = exe.run(fluid.default_main_program(),
+                          feed=feeder.feed(data), fetch_list=[avg_cost, acc])
+        losses.append(float(np.ravel(loss)[0]))
+        if i >= 30:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses

@@ -1,0 +1,78 @@
+"""Book ch08: machine translation, seq2seq encoder-decoder with attention
+(reference tests/book/test_machine_translation.py). Training path; beam
+search decode is exercised in test_beam_search once available."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+DICT_SIZE = 200
+WORD_DIM = 16
+HID = 32
+
+
+def encoder_decoder():
+    src = fluid.layers.data(name="src_word_id", shape=[1], dtype="int64",
+                            lod_level=1)
+    src_emb = fluid.layers.embedding(input=src, size=[DICT_SIZE, WORD_DIM])
+    fc1 = fluid.layers.fc(input=src_emb, size=HID * 4, num_flatten_dims=2,
+                          act="tanh")
+    enc_hidden, _ = fluid.layers.dynamic_lstm(input=fc1, size=HID * 4)
+    enc_last = fluid.layers.sequence_last_step(enc_hidden)
+
+    trg = fluid.layers.data(name="target_language_word", shape=[1],
+                            dtype="int64", lod_level=1)
+    trg_emb = fluid.layers.embedding(input=trg, size=[DICT_SIZE, WORD_DIM])
+
+    rnn = fluid.layers.DynamicRNN()
+    with rnn.block():
+        x_t = rnn.step_input(trg_emb)
+        mem = rnn.memory(init=enc_last)
+        # additive attention over encoder states
+        expanded = fluid.layers.sequence_expand(x=mem, y=enc_hidden)
+        scores = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(expanded, enc_hidden), dim=2,
+            keep_dim=False)
+        weights = fluid.layers.sequence_softmax(scores)
+        weighted = fluid.layers.elementwise_mul(enc_hidden, weights, axis=0)
+        context = fluid.layers.sequence_pool(weighted, "sum")
+        decoder_inputs = fluid.layers.concat([context, x_t], axis=1)
+        h = fluid.layers.fc(input=[decoder_inputs, mem], size=HID,
+                            act="tanh")
+        rnn.update_memory(mem, h)
+        out = fluid.layers.fc(input=h, size=DICT_SIZE)
+        rnn.step_output(out)
+    logits = rnn()
+    return src, trg, logits
+
+
+def test_machine_translation_train():
+    import random
+    random.seed(90)  # reader.shuffle uses the global random state
+    src, trg, logits = encoder_decoder()
+    label = fluid.layers.data(name="target_language_next_word", shape=[1],
+                              dtype="int64", lod_level=1)
+    cost = fluid.layers.softmax_with_cross_entropy(
+        logits=logits, label=label, seq_mask=True)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.Adam(learning_rate=4e-3).minimize(avg_cost)
+
+    train_reader = fluid.batch(
+        fluid.reader.shuffle(fluid.dataset.wmt14.train(DICT_SIZE),
+                             buf_size=1000), batch_size=16)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(place=place, feed_list=[src, trg, label])
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for epoch in range(2):
+        for i, data in enumerate(train_reader()):
+            data = [([[w] for w in s], [[w] for w in t], [[w] for w in n])
+                    for s, t, n in data]
+            loss, = exe.run(fluid.default_main_program(),
+                            feed=feeder.feed(data), fetch_list=[avg_cost])
+            losses.append(float(np.ravel(loss)[0]))
+            if i >= 100:
+                break
+    assert np.mean(losses[-5:]) < losses[0] * 0.8, (losses[0], losses[-5:])

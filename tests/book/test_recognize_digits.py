@@ -1,0 +1,42 @@
+"""Book ch02: digit recognition, MLP + conv variants (reference
+tests/book/test_recognize_digits.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+
+
+@pytest.mark.parametrize("net", ["mlp", "conv"])
+def test_recognize_digits(net):
+    if net == "mlp":
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        model_fn = models.mnist_mlp
+    else:
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        model_fn = models.mnist_conv
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    avg_cost, predict, acc = models.build_image_classifier(
+        model_fn, img, label, class_dim=10)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+
+    train_reader = fluid.batch(
+        fluid.reader.shuffle(fluid.dataset.mnist.train(), buf_size=500),
+        batch_size=64)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
+    exe.run(fluid.default_startup_program())
+
+    accs = []
+    for i, data in enumerate(train_reader()):
+        if net == "conv":
+            data = [(np.reshape(im, (1, 28, 28)), l) for im, l in data]
+        loss, a = exe.run(fluid.default_main_program(),
+                          feed=feeder.feed(data), fetch_list=[avg_cost, acc])
+        accs.append(float(np.ravel(a)[0]))
+        if i >= 60:
+            break
+    assert np.mean(accs[-10:]) > 0.7, accs[-10:]

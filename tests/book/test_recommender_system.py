@@ -1,0 +1,63 @@
+"""Book ch05: recommender system (reference
+tests/book/test_recommender_system.py): user/movie feature embeddings,
+cosine-ish matching via fc, squared-error on score."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_recommender_system():
+    ml = fluid.dataset.movielens
+
+    uid = fluid.layers.data(name="user_id", shape=[1], dtype="int64")
+    gender = fluid.layers.data(name="gender_id", shape=[1], dtype="int64")
+    age = fluid.layers.data(name="age_id", shape=[1], dtype="int64")
+    job = fluid.layers.data(name="job_id", shape=[1], dtype="int64")
+    mid = fluid.layers.data(name="movie_id", shape=[1], dtype="int64")
+    category = fluid.layers.data(name="category_id", shape=[1],
+                                 dtype="int64", lod_level=1)
+    title = fluid.layers.data(name="movie_title", shape=[1],
+                              dtype="int64", lod_level=1)
+    score = fluid.layers.data(name="score", shape=[1], dtype="float32")
+
+    def fc_emb(var, size, dim=16):
+        e = fluid.layers.embedding(input=var, size=[size, dim])
+        return fluid.layers.fc(input=e, size=16)
+
+    usr = fluid.layers.concat(
+        [fc_emb(uid, ml.max_user_id() + 1),
+         fc_emb(gender, 2), fc_emb(age, 8), fc_emb(job, ml.max_job_id() + 1)],
+        axis=1)
+    usr_feat = fluid.layers.fc(input=usr, size=32, act="tanh")
+
+    mov_emb = fc_emb(mid, ml.max_movie_id() + 1)
+    cat_emb = fluid.layers.embedding(input=category, size=[18, 16])
+    cat_pool = fluid.layers.sequence_pool(cat_emb, "sum")
+    tit_emb = fluid.layers.embedding(input=title, size=[5175, 16])
+    tit_pool = fluid.layers.sequence_pool(tit_emb, "sum")
+    mov = fluid.layers.concat([mov_emb, cat_pool, tit_pool], axis=1)
+    mov_feat = fluid.layers.fc(input=mov, size=32, act="tanh")
+
+    sim = fluid.layers.cos_sim(X=usr_feat, Y=mov_feat)
+    predict = fluid.layers.scale(sim, scale=5.0)
+    cost = fluid.layers.square_error_cost(input=predict, label=score)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+
+    train_reader = fluid.batch(ml.train(), batch_size=64)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(
+        place=place, feed_list=[uid, gender, age, job, mid, category, title,
+                                score])
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for i, data in enumerate(train_reader()):
+        loss, = exe.run(fluid.default_main_program(),
+                        feed=feeder.feed(data), fetch_list=[avg_cost])
+        losses.append(float(np.ravel(loss)[0]))
+        if i >= 40:
+            break
+    assert losses[-1] < losses[0], (losses[0], losses[-1])

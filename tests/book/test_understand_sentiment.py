@@ -1,0 +1,72 @@
+"""Book ch06: sentiment classification, conv + stacked-LSTM variants
+(reference tests/book/test_understand_sentiment.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def convolution_net(data, input_dim, class_dim=2, emb_dim=32, hid_dim=32):
+    emb = fluid.layers.embedding(input=data, size=[input_dim, emb_dim])
+    conv_3 = fluid.nets.sequence_conv_pool(input=emb, num_filters=hid_dim,
+                                           filter_size=3, act="tanh",
+                                           pool_type="sqrt")
+    conv_4 = fluid.nets.sequence_conv_pool(input=emb, num_filters=hid_dim,
+                                           filter_size=4, act="tanh",
+                                           pool_type="sqrt")
+    return fluid.layers.fc(input=[conv_3, conv_4], size=class_dim)
+
+
+def stacked_lstm_net(data, input_dim, class_dim=2, emb_dim=32, hid_dim=32,
+                     stacked_num=3):
+    emb = fluid.layers.embedding(input=data, size=[input_dim, emb_dim])
+    fc1 = fluid.layers.fc(input=emb, size=hid_dim, num_flatten_dims=2)
+    lstm1, cell1 = fluid.layers.dynamic_lstm(input=fc1, size=hid_dim)
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = fluid.layers.fc(input=inputs, size=hid_dim, num_flatten_dims=2)
+        lstm, cell = fluid.layers.dynamic_lstm(input=fc, size=hid_dim,
+                                               is_reverse=(i % 2) == 0)
+        inputs = [fc, lstm]
+    fc_last = fluid.layers.sequence_pool(input=inputs[0], pool_type="max")
+    lstm_last = fluid.layers.sequence_pool(input=inputs[1], pool_type="max")
+    return fluid.layers.fc(input=[fc_last, lstm_last], size=class_dim)
+
+
+@pytest.mark.parametrize("net", ["conv", "stacked_lstm"])
+def test_understand_sentiment(net):
+    word_dict = fluid.dataset.imdb.word_dict()
+    dict_dim = len(word_dict)
+
+    data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                             lod_level=1)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    if net == "conv":
+        logits = convolution_net(data, dict_dim)
+    else:
+        logits = stacked_lstm_net(data, dict_dim)
+    cost = fluid.layers.softmax_with_cross_entropy(logits=logits, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    acc = fluid.layers.accuracy(input=fluid.layers.softmax(logits),
+                                label=label)
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+
+    train_reader = fluid.batch(
+        fluid.reader.shuffle(fluid.dataset.imdb.train(word_dict),
+                             buf_size=1000), batch_size=32)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(place=place, feed_list=[data, label])
+    exe.run(fluid.default_startup_program())
+
+    accs = []
+    for i, data_batch in enumerate(train_reader()):
+        data_batch = [([[w] for w in ws], [l]) for ws, l in data_batch]
+        loss, a = exe.run(fluid.default_main_program(),
+                          feed=feeder.feed(data_batch),
+                          fetch_list=[avg_cost, acc])
+        accs.append(float(np.ravel(a)[0]))
+        if i >= 30:
+            break
+    assert np.mean(accs[-5:]) > 0.8, accs

@@ -1,0 +1,49 @@
+"""Book ch04: word2vec N-gram LM (reference tests/book/test_word2vec.py):
+4 context embeddings with a shared table -> fc -> softmax next-word."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_word2vec():
+    word_dict = fluid.dataset.imikolov.build_dict()
+    dict_size = len(word_dict)
+    EMBED = 32
+
+    def emb(name_var):
+        return fluid.layers.embedding(
+            input=name_var, size=[dict_size, EMBED],
+            param_attr=fluid.ParamAttr(name="shared_w"))
+
+    first = fluid.layers.data(name="firstw", shape=[1], dtype="int64")
+    second = fluid.layers.data(name="secondw", shape=[1], dtype="int64")
+    third = fluid.layers.data(name="thirdw", shape=[1], dtype="int64")
+    forth = fluid.layers.data(name="forthw", shape=[1], dtype="int64")
+    next_word = fluid.layers.data(name="nextw", shape=[1], dtype="int64")
+
+    concat = fluid.layers.concat(
+        input=[emb(first), emb(second), emb(third), emb(forth)], axis=1)
+    hidden = fluid.layers.fc(input=concat, size=128, act="sigmoid")
+    logits = fluid.layers.fc(input=hidden, size=dict_size)
+    cost = fluid.layers.softmax_with_cross_entropy(logits=logits,
+                                                   label=next_word)
+    avg_cost = fluid.layers.mean(cost)
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+
+    train_reader = fluid.batch(fluid.dataset.imikolov.train(word_dict, 5),
+                               batch_size=64)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(
+        place=place, feed_list=[first, second, third, forth, next_word])
+    exe.run(fluid.default_startup_program())
+
+    losses = []
+    for epoch in range(3):
+        for data in train_reader():
+            data = [([a], [b], [c], [d], [e]) for a, b, c, d, e in data]
+            loss, = exe.run(fluid.default_main_program(),
+                            feed=feeder.feed(data), fetch_list=[avg_cost])
+            losses.append(float(np.ravel(loss)[0]))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
