@@ -125,6 +125,15 @@ class LayerHelper:
     def append_op(self, **kwargs):
         return self.block.append_op(**kwargs)
 
+    def get_parameter(self, name):
+        """Look up an existing parameter by name (reference
+        layer_helper.py; used e.g. to share the CRF transition between
+        linear_chain_crf and crf_decoding)."""
+        param = self.main_program.global_block().var(name)
+        if not isinstance(param, Parameter):
+            raise ValueError(f"no parameter named {name}")
+        return param
+
     def append_bias_op(self, input_var, dim_start=1, dim_end=None):
         """Add a bias over dims [dim_start, dim_end) of input
         (reference layer_helper.py append_bias_op)."""

@@ -26,6 +26,7 @@ __all__ = [
     "label_smooth", "cos_sim", "expand", "squeeze", "unsqueeze", "gather",
     "scatter", "pad", "nce", "row_conv", "im2sequence", "multiplex",
     "sigmoid_cross_entropy_with_logits", "maxout",
+    "linear_chain_crf", "crf_decoding", "beam_search", "beam_search_decode",
 ]
 
 
@@ -672,3 +673,78 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     counter.stop_gradient = True
     counter.desc.stop_gradient = True
     return counter
+
+
+# --- CRF --------------------------------------------------------------------
+
+def linear_chain_crf(input, label, param_attr=None):
+    """Linear-chain CRF cost (reference nn.py:787, linear_chain_crf_op.cc).
+    input: padded emissions [B,T,D]; label: [B,T,1] int. Returns the per-
+    sequence negative log-likelihood [B,1]. The transition parameter is
+    [D+2, D] (start row, stop row, pairwise matrix)."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=input.dtype)
+    log_likelihood = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="linear_chain_crf",
+                     inputs={"Emission": [input],
+                             "Transition": [transition],
+                             "Label": [label]},
+                     outputs={"LogLikelihood": [log_likelihood]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decoding (reference crf_decoding_op.cc). With label, emits a
+    per-token correctness indicator instead of the path."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    transition = helper.get_parameter(helper.param_attr.name)
+    viterbi_path = helper.create_tmp_variable("int64")
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [viterbi_path]})
+    return viterbi_path
+
+
+# --- beam search ------------------------------------------------------------
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id, level=0):
+    """One beam-search step over dense [B,K] lanes (reference nn.py:1903,
+    beam_search_op.cc; the reference tracks beams in LoD levels — here
+    parent indices are returned explicitly). Returns
+    (selected_ids [B,K], selected_scores [B,K], parent_idx [B,K])."""
+    helper = LayerHelper("beam_search")
+    selected_ids = helper.create_tmp_variable("int64")
+    selected_scores = helper.create_tmp_variable(scores.dtype)
+    parent_idx = helper.create_tmp_variable("int32")
+    helper.append_op(type="beam_search",
+                     inputs={"pre_ids": [pre_ids],
+                             "pre_scores": [pre_scores],
+                             "scores": [scores]},
+                     outputs={"selected_ids": [selected_ids],
+                              "selected_scores": [selected_scores],
+                              "parent_idx": [parent_idx]},
+                     attrs={"beam_size": beam_size, "end_id": end_id,
+                            "level": level})
+    return selected_ids, selected_scores, parent_idx
+
+
+def beam_search_decode(ids, parent_idx, scores=None, beam_size=None,
+                       end_id=1):
+    """Backtrack beam TensorArrays into final hypotheses (reference
+    beam_search_decode_op.cc). Returns (sentence_ids [B,K,T],
+    sentence_scores [B,K])."""
+    helper = LayerHelper("beam_search_decode")
+    sentence_ids = helper.create_tmp_variable("int64")
+    sentence_scores = helper.create_tmp_variable("float32")
+    inputs = {"Ids": [ids], "ParentIdx": [parent_idx]}
+    if scores is not None:
+        inputs["Scores"] = [scores]
+    helper.append_op(type="beam_search_decode", inputs=inputs,
+                     outputs={"SentenceIds": [sentence_ids],
+                              "SentenceScores": [sentence_scores]},
+                     attrs={"end_id": end_id})
+    return sentence_ids, sentence_scores

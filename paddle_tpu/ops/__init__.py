@@ -8,5 +8,7 @@ from . import optimizer_ops  # noqa: F401
 from . import extra_ops      # noqa: F401
 from . import sequence_ops   # noqa: F401
 from . import control_flow_ops  # noqa: F401
+from . import crf_ops        # noqa: F401
+from . import beam_search_ops  # noqa: F401
 
 from .registry import register, op, get, try_get, registered_ops, NO_GRAD

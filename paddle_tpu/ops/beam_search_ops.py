@@ -1,0 +1,92 @@
+"""Beam-search ops on dense [batch, beam] tensors.
+
+TPU-native equivalents of the reference's LoD-based beam machinery
+(reference: paddle/fluid/operators/beam_search_op.cc — per-step candidate
+selection over LoD beams; beam_search_decode_op.cc — backtracking the
+step arrays into final hypotheses). The reference encodes beams in LoD
+levels with dynamic widths; XLA wants static shapes, so beams live in a
+fixed [B, K] lane layout: finished beams (last id == end_id) are frozen
+lanes that propagate end_id with unchanged score. Selection is one
+jnp.top_k over the K*V flattened candidates per batch row — MXU/VPU
+friendly, no host round-trips inside the decode loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import NO_GRAD, op
+
+
+@op("beam_search", grad=NO_GRAD)
+def _beam_search(ctx, op_, ins):
+    """One decode step. pre_ids [B,K] int, pre_scores [B,K] float,
+    scores [B,K,V] per-beam next-token log-probs. Returns selected ids,
+    cumulative scores, and parent beam indices, all [B,K]."""
+    pre_ids = jnp.asarray(ins["pre_ids"][0]).astype(jnp.int32)
+    pre_scores = jnp.asarray(ins["pre_scores"][0])
+    scores = jnp.asarray(ins["scores"][0])
+    if pre_ids.ndim == 3:
+        pre_ids = pre_ids[..., 0]
+    bsz, k, v = scores.shape
+    beam_size = int(op_.attr("beam_size", k))
+    end_id = int(op_.attr("end_id", 1))
+    assert beam_size == k, "beam lane count must equal beam_size"
+
+    finished = pre_ids == end_id                                    # [B,K]
+    # frozen lanes: only candidate is end_id with +0 score
+    neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+    frozen_row = jnp.full((v,), neg, scores.dtype).at[end_id].set(0.0)
+    step_scores = jnp.where(finished[..., None], frozen_row[None, None, :],
+                            scores)
+    cum = pre_scores[..., None] + step_scores                       # [B,K,V]
+    flat = cum.reshape(bsz, k * v)
+    top_scores, top_idx = lax.top_k(flat, beam_size)                # [B,K]
+    parent = (top_idx // v).astype(jnp.int32)
+    token = (top_idx % v).astype(jnp.int64)
+    return {"selected_ids": [token], "selected_scores": [top_scores],
+            "parent_idx": [parent]}
+
+
+@op("beam_search_decode", grad=NO_GRAD)
+def _beam_search_decode(ctx, op_, ins):
+    """Backtrack step arrays into final hypotheses
+    (reference beam_search_decode_op.cc). Ids/ParentIdx are TensorArrays of
+    [B,K] steps; returns SentenceIds [B,K,T] (end_id-padded) and
+    SentenceScores [B,K] (cumulative score of each lane at the last step)."""
+    ids_arr = ins["Ids"][0]
+    parents_arr = ins["ParentIdx"][0]
+    scores_arr = ins["Scores"][0] if ins.get("Scores") and \
+        ins["Scores"][0] is not None else None
+    end_id = int(op_.attr("end_id", 1))
+
+    ids_buf = ids_arr.buffer                                        # [C,B,K]
+    par_buf = parents_arr.buffer
+    n = ids_arr.length                                              # scalar
+    cap, bsz, k = ids_buf.shape
+    lane = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :],
+                            (bsz, k))
+
+    def back(carry, i):
+        lanes = carry                                               # [B,K]
+        step = n - 1 - i                                            # traced
+        valid = step >= 0
+        sstep = jnp.maximum(step, 0)
+        tok = jnp.take_along_axis(ids_buf[sstep], lanes, axis=1)
+        par = jnp.take_along_axis(par_buf[sstep], lanes, axis=1)
+        tok = jnp.where(valid, tok, end_id)
+        new_lanes = jnp.where(valid, par, lanes)
+        return new_lanes, tok
+
+    _, toks_rev = lax.scan(back, lane, jnp.arange(cap))
+    sentences = jnp.swapaxes(jnp.swapaxes(toks_rev[::-1], 0, 1), 1, 2)
+    # [B,K,C]; steps beyond length already hold end_id
+    if scores_arr is not None:
+        last = jnp.maximum(n - 1, 0)
+        final_scores = scores_arr.buffer[last]                      # [B,K]
+    else:
+        final_scores = jnp.zeros((bsz, k), jnp.float32)
+    return {"SentenceIds": [sentences.astype(jnp.int64)],
+            "SentenceScores": [final_scores]}

@@ -1,0 +1,151 @@
+"""Linear-chain CRF ops on padded sequences.
+
+TPU-native equivalents of the reference's CRF kernels
+(reference: paddle/fluid/operators/linear_chain_crf_op.cc — forward
+algorithm + analytic gradients; crf_decoding_op.cc — Viterbi). Here the
+forward recursion is a log-domain lax.scan and the gradient falls out of
+jax.vjp through it (no hand-written backward); Viterbi is a scan with
+backtracking via a second reverse scan.
+
+Transition parameter layout matches the reference: [D+2, D] where row 0 is
+the start transition, row 1 the stop transition, rows 2.. the pairwise
+transition matrix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .common import in_var, set_out
+from .registry import NO_GRAD, op
+
+
+def _crf_infer(op_, block):
+    ev = in_var(op_, block, "Emission")
+    if ev is None or ev.shape is None:
+        return
+    set_out(op_, block, "LogLikelihood", [ev.shape[0], 1], ev.dtype)
+
+
+def _lengths_for(ctx, op_, slot):
+    names = op_.desc.inputs.get(slot, [])
+    return ctx.seq_len(names[0]) if names else None
+
+
+@op("linear_chain_crf", infer_shape=_crf_infer, non_diff_inputs=("Label",))
+def _linear_chain_crf(ctx, op_, ins):
+    """Negative log-likelihood of label paths under a linear-chain CRF.
+
+    Emission [B,T,D] padded; Transition [D+2,D]; Label [B,T,1] int.
+    Returns LogLikelihood [B,1] = -log p(label path | emission) per
+    sequence (the training cost, as in the reference book ch07)."""
+    emission = jnp.asarray(ins["Emission"][0])
+    transition = jnp.asarray(ins["Transition"][0])
+    label = jnp.asarray(ins["Label"][0]).astype(jnp.int32)
+    if label.ndim == 3:
+        label = label[..., 0]
+    bsz, t, d = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    lengths = _lengths_for(ctx, op_, "Emission")
+    if lengths is None:
+        lengths = jnp.full((bsz,), t, jnp.int32)
+    lengths = jnp.asarray(lengths)
+    steps = jnp.arange(t)
+    mask = (steps[None, :] < lengths[:, None]).astype(emission.dtype)
+
+    # --- partition function: log-domain forward recursion -------------------
+    alpha0 = start[None, :] + emission[:, 0]                       # [B, D]
+
+    def fwd(alpha, inp):
+        e_t, m_t = inp                                             # [B,D],[B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + e_t
+        alpha = m_t[:, None] * nxt + (1 - m_t)[:, None] * alpha
+        return alpha, None
+
+    es = jnp.swapaxes(emission, 0, 1)                              # [T,B,D]
+    ms = jnp.swapaxes(mask, 0, 1)                                  # [T,B]
+    alpha, _ = lax.scan(fwd, alpha0, (es[1:], ms[1:]))
+    log_z = jax.nn.logsumexp(alpha + stop[None, :], axis=1)        # [B]
+
+    # --- gold path score ----------------------------------------------------
+    emit_scores = jnp.take_along_axis(emission, label[..., None],
+                                      axis=2)[..., 0]              # [B,T]
+    emit_sum = (emit_scores * mask).sum(axis=1)
+    pair = trans[label[:, :-1], label[:, 1:]]                      # [B,T-1]
+    pair_sum = (pair * mask[:, 1:]).sum(axis=1)
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_label = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    path = start[label[:, 0]] + emit_sum + pair_sum + stop[last_label]
+
+    nll = (log_z - path)[:, None]
+    for name in op_.desc.outputs.get("LogLikelihood", []):
+        ctx.set_seq_len(name, None)
+    return {"LogLikelihood": [nll]}
+
+
+def _decode_infer(op_, block):
+    ev = in_var(op_, block, "Emission")
+    if ev is None or ev.shape is None:
+        return
+    set_out(op_, block, "ViterbiPath", list(ev.shape[:2]) + [1], "int64")
+
+
+@op("crf_decoding", infer_shape=_decode_infer, grad=NO_GRAD)
+def _crf_decoding(ctx, op_, ins):
+    """Viterbi decode (reference crf_decoding_op.cc). Without Label: the
+    best path [B,T,1]. With Label: per-token correctness indicator
+    (1 where the Viterbi tag equals the gold tag), as the reference emits
+    for evaluation."""
+    emission = jnp.asarray(ins["Emission"][0])
+    transition = jnp.asarray(ins["Transition"][0])
+    bsz, t, d = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    lengths = _lengths_for(ctx, op_, "Emission")
+    if lengths is None:
+        lengths = jnp.full((bsz,), t, jnp.int32)
+    lengths = jnp.asarray(lengths)
+    mask = (jnp.arange(t)[None, :] < lengths[:, None])
+
+    delta0 = start[None, :] + emission[:, 0]
+
+    def fwd(delta, inp):
+        e_t, m_t = inp
+        cand = delta[:, :, None] + trans[None]                      # [B,D,D]
+        best = cand.max(axis=1) + e_t
+        back = cand.argmax(axis=1).astype(jnp.int32)                # [B,D]
+        delta = jnp.where(m_t[:, None], best, delta)
+        return delta, back
+
+    es = jnp.swapaxes(emission, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+    delta, backs = lax.scan(fwd, delta0, (es[1:], ms[1:]))  # backs [T-1,B,D]
+    last = jnp.argmax(delta + stop[None, :], axis=1).astype(jnp.int32)  # [B]
+
+    # backtrack from each sequence's last valid step; padded steps keep the
+    # carried tag so the valid region reads out correctly
+    def back_step(tag, inp):
+        back_t, m_next = inp         # back at step t+1, mask of step t+1
+        prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+        tag = jnp.where(m_next, prev, tag)
+        return tag, tag
+
+    ms_next = ms[1:]                 # mask for steps 1..T-1
+    _, tags_rev = lax.scan(back_step, last, (backs[::-1], ms_next[::-1]))
+    path = jnp.concatenate([tags_rev[::-1], last[None, :]], axis=0)  # [T,B]
+    path = jnp.swapaxes(path, 0, 1)
+    path = jnp.where(mask, path, 0).astype(jnp.int64)[..., None]     # [B,T,1]
+
+    out_names = op_.desc.outputs.get("ViterbiPath", [])
+    if ins.get("Label") and ins["Label"][0] is not None:
+        label = jnp.asarray(ins["Label"][0]).astype(jnp.int64)
+        if label.ndim == 2:
+            label = label[..., None]
+        correct = (path == label) & mask[..., None]
+        result = correct.astype(jnp.int64)
+    else:
+        result = path
+    for name in out_names:
+        ctx.set_seq_len(name, lengths)
+    return {"ViterbiPath": [result]}

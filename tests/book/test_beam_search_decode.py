@@ -1,0 +1,88 @@
+"""Beam-search generation: While loop + TensorArrays + beam_search ops
+(reference tests/book/test_machine_translation.py decode_main +
+beam_search_op.cc/beam_search_decode_op.cc). Builds a decoder over dense
+[B,K] beam lanes and checks the selected hypotheses are consistent."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+V = 50          # vocab
+K = 4           # beam width
+MAX_LEN = 6
+START, END = 0, 1
+H = 16
+
+
+def build_decode_program():
+    src = fluid.layers.data(name="src", shape=[1], dtype="int64",
+                            lod_level=1)
+    src_emb = fluid.layers.embedding(input=src, size=[V, H])
+    enc = fluid.layers.sequence_pool(src_emb, "sum")      # [B,H] context
+
+    counter = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+    max_len = fluid.layers.fill_constant(shape=[1], dtype="int64",
+                                         value=MAX_LEN)
+    # beam lanes: ids [B,K]; scores [B,K] with only lane 0 live initially
+    init_ids = fluid.layers.fill_constant_batch_size_like(
+        input=enc, shape=[-1, K], dtype="int64", value=START)
+    lane_penalty = fluid.layers.assign(
+        np.concatenate([[0.0], np.full(K - 1, -1e9)]).astype(np.float32))
+    init_scores = fluid.layers.elementwise_add(
+        fluid.layers.fill_constant_batch_size_like(
+            input=enc, shape=[-1, K], dtype="float32", value=0.0),
+        lane_penalty, axis=1)
+
+    ids_arr = fluid.layers.array_write(init_ids, counter, capacity=MAX_LEN + 1)
+    parents_arr = fluid.layers.array_write(
+        fluid.layers.cast(init_ids, "int32"), counter, capacity=MAX_LEN + 1)
+    scores_arr = fluid.layers.array_write(init_scores, counter,
+                                          capacity=MAX_LEN + 1)
+
+    pre_ids = fluid.layers.assign(init_ids)
+    pre_scores = fluid.layers.assign(init_scores)
+
+    cond = fluid.layers.less_than(x=counter, y=max_len)
+    w = fluid.layers.While(cond=cond)
+    with w.block():
+        tok_emb = fluid.layers.embedding(input=pre_ids, size=[V, H])  # [B,K,H]
+        logits = fluid.layers.fc(input=tok_emb, size=V, num_flatten_dims=2)
+        logp = fluid.layers.log(fluid.layers.softmax(logits))
+        sel_ids, sel_scores, parent = fluid.layers.beam_search(
+            pre_ids=pre_ids, pre_scores=pre_scores, scores=logp,
+            beam_size=K, end_id=END)
+        fluid.layers.increment(counter, value=1, in_place=True)
+        fluid.layers.array_write(sel_ids, counter, array=ids_arr)
+        fluid.layers.array_write(parent, counter, array=parents_arr)
+        fluid.layers.array_write(sel_scores, counter, array=scores_arr)
+        fluid.layers.assign(sel_ids, pre_ids)
+        fluid.layers.assign(sel_scores, pre_scores)
+        fluid.layers.less_than(x=counter, y=max_len, cond=cond)
+
+    sentences, final_scores = fluid.layers.beam_search_decode(
+        ids_arr, parents_arr, scores=scores_arr, end_id=END)
+    return src, sentences, final_scores
+
+
+def test_beam_search_decode():
+    src, sentences, final_scores = build_decode_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    from paddle_tpu.executor import LoDTensor
+    rows = [np.random.RandomState(i).randint(2, V, (3, 1)).astype(np.int64)
+            for i in range(3)]
+    flat = np.concatenate(rows, 0)
+    offs = [0, 3, 6, 9]
+    out_ids, out_scores = exe.run(
+        fluid.default_main_program(),
+        feed={"src": LoDTensor(flat, [offs])},
+        fetch_list=[sentences, final_scores])
+
+    bsz = 3
+    assert out_ids.shape[0] == bsz and out_ids.shape[1] == K
+    assert (out_ids >= 0).all() and (out_ids < V).all()
+    # lanes come out of top_k: best lane first, scores non-increasing
+    assert (np.diff(out_scores, axis=1) <= 1e-5).all()
+    # every hypothesis starts from the START bootstrap lane
+    assert (out_ids[:, :, 0] == START).all()

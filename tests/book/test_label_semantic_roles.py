@@ -1,0 +1,98 @@
+"""Book ch07: semantic role labeling with a linear-chain CRF (reference
+tests/book/test_label_semantic_roles.py): 8 parallel input sequences ->
+embeddings -> bidirectional LSTM stack -> emissions -> CRF cost; Viterbi
+decode for evaluation."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+WORD_DICT_LEN = 2000   # active subset of the conll05 vocab
+PRED_DICT_LEN = fluid.dataset.conll05.PRED_VOCAB
+MARK_DICT_LEN = 2
+LABEL_N = fluid.dataset.conll05.LABEL_N
+EMB = 16
+HID = 32
+
+
+def db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark):
+    pred_emb = fluid.layers.embedding(input=predicate,
+                                      size=[PRED_DICT_LEN, EMB])
+    mark_emb = fluid.layers.embedding(input=mark, size=[MARK_DICT_LEN, EMB])
+    word_inputs = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+    embs = [fluid.layers.embedding(
+        input=w, size=[WORD_DICT_LEN, EMB],
+        param_attr=fluid.ParamAttr(name="word_emb")) for w in word_inputs]
+    embs += [pred_emb, mark_emb]
+
+    hidden_0 = fluid.layers.fc(input=embs, size=HID, num_flatten_dims=2,
+                               act="tanh")
+    lstm_0, _ = fluid.layers.dynamic_lstm(input=fluid.layers.fc(
+        input=hidden_0, size=HID * 4, num_flatten_dims=2), size=HID * 4)
+    # stacked bidirectional: alternate direction each depth
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(2):
+        mix = fluid.layers.fc(input=input_tmp, size=HID * 4,
+                              num_flatten_dims=2)
+        lstm, _ = fluid.layers.dynamic_lstm(input=mix, size=HID * 4,
+                                            is_reverse=(i % 2 == 0))
+        input_tmp = [mix, lstm]
+    emission = fluid.layers.fc(input=input_tmp, size=LABEL_N,
+                               num_flatten_dims=2)
+    return emission
+
+
+def test_label_semantic_roles():
+    names = ["word_data", "verb_data", "ctx_n2_data", "ctx_n1_data",
+             "ctx_0_data", "ctx_p1_data", "ctx_p2_data", "mark_data"]
+    feeds = [fluid.layers.data(name=n, shape=[1], dtype="int64", lod_level=1)
+             for n in names]
+    target = fluid.layers.data(name="target", shape=[1], dtype="int64",
+                               lod_level=1)
+    emission = db_lstm(*feeds)
+    crf_cost = fluid.layers.linear_chain_crf(
+        input=emission, label=target,
+        param_attr=fluid.ParamAttr(name="crfw"))
+    avg_cost = fluid.layers.mean(crf_cost)
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(avg_cost)
+
+    # Viterbi decode path shares the transition parameter
+    decode = fluid.layers.crf_decoding(
+        input=emission, param_attr=fluid.ParamAttr(name="crfw"))
+
+    def sample(rng):
+        ln = int(rng.randint(4, 12))
+        words = rng.randint(0, 200, ln)
+        pred_id = int(rng.randint(0, 50))
+        labels = (words * 7) % LABEL_N  # word-determined tag: learnable
+        ctxs = [np.roll(words, k) for k in (-2, -1, 0, 1, 2)]
+        mark = (rng.rand(ln) < 0.2).astype(np.int64)
+        return (words, np.full(ln, pred_id), *ctxs, mark, labels)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    feeder = fluid.DataFeeder(place=place, feed_list=feeds + [target])
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    losses = []
+    for step in range(80):
+        batch = []
+        for _ in range(16):
+            fields = sample(rng)
+            batch.append(tuple([[int(v)] for v in f] for f in fields))
+        l, = exe.run(fluid.default_main_program(),
+                     feed=feeder.feed(batch), fetch_list=[avg_cost])
+        losses.append(float(np.ravel(l)[0]))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.7, (
+        losses[:5], losses[-5:])
+
+    # decode produces a valid path over the batch
+    batch = []
+    for _ in range(4):
+        fields = sample(rng)
+        batch.append(tuple([[int(v)] for v in f] for f in fields))
+    path, = exe.run(fluid.default_main_program(),
+                    feed=feeder.feed(batch), fetch_list=[decode])
+    assert np.issubdtype(path.dtype, np.integer)
+    assert (path >= 0).all() and (path < LABEL_N).all()
