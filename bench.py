@@ -16,9 +16,17 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 81.69
-BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+BATCH = int(os.environ.get("BENCH_BATCH", "768"))
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
+AMP = os.environ.get("BENCH_AMP", "1") == "1"
+AMP_LEVEL = os.environ.get("BENCH_AMP_LEVEL", "O2")
+# ResNet-50 @224: ~4.09 GFLOP forward per image (counting FMA as 2 FLOPs);
+# a training step costs ~3x forward (fwd + input grad + weight grad).
+TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
+# per-chip bf16 peak for MFU reporting (v5e ~197 TF/s, v4 ~275, v5p ~459);
+# override with BENCH_PEAK_TFLOPS for other chips.
+PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
 
 
 def main():
@@ -33,6 +41,10 @@ def main():
         avg_cost, _, _ = models.build_image_classifier(
             models.resnet50, img, label, class_dim=1000)
         opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        if AMP:
+            # bf16 matmul/conv compute on the MXU, fp32 master weights;
+            # O2 keeps activations bf16 end-to-end (halves HBM traffic)
+            opt = fluid.amp.decorate(opt, level=AMP_LEVEL)
         opt.minimize(avg_cost, startup_program=startup)
 
     exe = fluid.Executor(fluid.TPUPlace(0))
@@ -48,21 +60,31 @@ def main():
             "label": jax.device_put(y, exe.device)}
 
     for _ in range(max(WARMUP, 1)):
-        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
+        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                        return_numpy=False)
     float(np.asarray(loss).ravel()[0])  # sync
 
+    # return_numpy=False keeps the fetched loss on-device: steps enqueue
+    # back to back with no per-step host sync; one sync at the end.
     t0 = time.perf_counter()
     for _ in range(STEPS):
-        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost])
-    float(np.asarray(loss).ravel()[0])  # sync on the last step
+        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
+                        return_numpy=False)
+    final_loss = float(np.asarray(loss).ravel()[0])  # sync on the last step
     dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
 
     img_s = BATCH * STEPS / dt
+    mfu = img_s * TRAIN_FLOPS_PER_IMG / (PEAK_TFLOPS * 1e12)
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec",
         "value": round(img_s, 2),
         "unit": "images/sec",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "batch": BATCH,
+        "amp": AMP,
+        "amp_level": AMP_LEVEL if AMP else None,
+        "mfu": round(mfu, 4),
     }))
 
 

@@ -39,6 +39,8 @@ from . import dataset
 from .minibatch import batch
 from . import parallel
 from . import profiler
+from . import amp
+from . import compat
 from . import metrics
 from .parallel import transpiler
 from .parallel.transpiler import DistributeTranspiler

@@ -1,0 +1,74 @@
+"""Automatic mixed precision (bf16 compute, fp32 master weights).
+
+The TPU-native replacement for the reference's fp16 support
+(reference: paddle/fluid/platform/float16.h:64 — an fp16 storage type with
+per-kernel CUDA intrinsics). On TPU the low-precision matmul/conv input type
+is bfloat16 (the MXU's native format), and because bf16 keeps float32's
+exponent range, no loss scaling is required. The policy here is the standard
+one:
+
+  * matmul/conv operands are cast to bf16 at the op (ops/common.py
+    mxu_cast), accumulating in fp32 (`preferred_element_type`);
+  * everything else — parameters ("fp32 master weights"), batch-norm
+    statistics, losses, optimizer state and updates — stays float32;
+  * gradients w.r.t. weights come back fp32 automatically: the cast is part
+    of the traced forward, so its vjp casts cotangents back to fp32.
+
+Usage:
+    fluid.amp.enable(program)               # or decorate(optimizer)
+    ...build/run as usual...
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .framework.framework import Program, default_main_program
+
+__all__ = ["enable", "disable", "decorate"]
+
+
+def enable(program: Optional[Program] = None, dtype: str = "bfloat16",
+           level: str = "O1"):
+    """Tag `program` (default: the default main program) so MXU-bound ops
+    compute in `dtype`. Takes effect on the next Executor.run — the compile
+    cache is keyed on the policy.
+
+    level="O1": matmul/conv compute in bf16, outputs restored to f32.
+    level="O2": activations stay bf16 end-to-end (halves HBM traffic);
+    norm statistics, losses, master weights and optimizer state stay f32.
+    """
+    assert level in ("O1", "O2"), level
+    program = program or default_main_program()
+    program._amp_dtype = dtype
+    program._amp_level = level
+    return program
+
+
+def disable(program: Optional[Program] = None):
+    program = program or default_main_program()
+    program._amp_dtype = None
+    return program
+
+
+class _DecoratedOptimizer:
+    """Source-compat shim mirroring later Paddle's
+    `fluid.contrib.mixed_precision.decorate(optimizer)`: minimize() enables
+    the bf16 policy on the program it builds into."""
+
+    def __init__(self, optimizer, dtype: str = "bfloat16",
+                 level: str = "O1"):
+        self._opt = optimizer
+        self._dtype = dtype
+        self._level = level
+
+    def minimize(self, loss, startup_program=None, **kw):
+        enable(loss.block.program, self._dtype, self._level)
+        return self._opt.minimize(loss, startup_program=startup_program, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+
+def decorate(optimizer, dtype: str = "bfloat16", level: str = "O1"):
+    return _DecoratedOptimizer(optimizer, dtype, level)

@@ -194,6 +194,11 @@ class LoweringContext:
         self.place = executor.place
         self._rng_key = rng_key
         self.lod_map = lod_map    # var name -> lod metadata (host-side)
+        # mixed-precision compute dtype for MXU-bound ops (amp.py); None =
+        # full precision. Read by ops.common.mxu_cast. Level O1 restores
+        # f32 after each MXU op; O2 keeps activations bf16 end-to-end.
+        self.amp_dtype = getattr(program, "_amp_dtype", None)
+        self.amp_level = getattr(program, "_amp_level", "O1")
         # live env of the block being traced; lowerings use it to read
         # sequence-length side channels (`<var>@SEQLEN`, see seq_len()).
         self.env: Dict[str, Any] = {}
@@ -367,7 +372,9 @@ class Executor:
         if jit_mode:
             key = (id(program), getattr(program, "_version", 0),
                    tuple(sorted(feed_vals)), tuple(fetch_names),
-                   tuple(state_keys), self.place)
+                   tuple(state_keys), self.place,
+                   getattr(program, "_amp_dtype", None),
+                   getattr(program, "_amp_level", "O1"))
             compiled = self._cache.get(key) if use_program_cache else None
             if compiled is None:
                 compiled = self._compile(program, state_keys, sorted(feed_vals),
@@ -482,12 +489,18 @@ class Executor:
         outs = opdef.lower(ctx, op, ins)
         # Default SEQLEN propagation mirrors the reference's LoD propagation
         # (most ops share LoD with their first sequence input); sequence
-        # lowerings override via ctx.set_seq_len.
+        # lowerings override via ctx.set_seq_len. Inheritance is restricted
+        # to outputs that PRESERVE the carrier's [batch, time] leading dims —
+        # an op that drops or reshapes the time axis (reductions, matmul
+        # collapses) is no longer a sequence, and tagging it would make the
+        # fetch path spuriously repack a dense tensor.
         inherited = None
+        carrier_shape = None
         for names in op.desc.inputs.values():
             for n in names:
                 if n + SEQLEN_SUFFIX in env:
                     inherited = env[n + SEQLEN_SUFFIX]
+                    carrier_shape = getattr(env.get(n), "shape", None)
                     break
             if inherited is not None:
                 break
@@ -503,7 +516,10 @@ class Executor:
                         else:
                             env[name + SEQLEN_SUFFIX] = sl
                     elif inherited is not None and hasattr(val, "ndim") \
-                            and getattr(val, "ndim", 0) >= 2:
+                            and getattr(val, "ndim", 0) >= 2 \
+                            and carrier_shape is not None \
+                            and len(carrier_shape) >= 2 \
+                            and tuple(val.shape[:2]) == tuple(carrier_shape[:2]):
                         env[name + SEQLEN_SUFFIX] = inherited
         ctx.env = prev_env
 

@@ -81,19 +81,51 @@ struct Scanner {
   FILE* f = nullptr;
   Chunk chunk;
   size_t cursor = 0;  // next record within chunk
+  // 0 = ok/EOF, 1 = corruption (bad magic, CRC mismatch, truncated chunk,
+  // decompress failure). The reference raises on corruption rather than
+  // silently truncating the dataset; this flag lets Python do the same.
+  int error = 0;
 
   bool LoadNextChunk() {
     chunk.Clear();
     cursor = 0;
     uint32_t head[6];
-    if (fread(head, sizeof(head), 1, f) != 1) return false;
-    if (head[0] != kMagic) return false;
-    std::string stored(head[4], '\0');
-    if (!stored.empty() && fread(&stored[0], stored.size(), 1, f) != 1)
+    long pos = ftell(f);
+    if (fread(head, sizeof(head), 1, f) != 1) {
+      // clean EOF only if the stream ended exactly on a chunk boundary;
+      // a partial header means the file was truncated mid-chunk
+      if (!feof(f) || ftell(f) != pos) error = 1;
       return false;
+    }
+    if (head[0] != kMagic) {
+      error = 1;
+      return false;
+    }
+    // Validate header sizes BEFORE allocating: a corrupt-but-magic-valid
+    // header could otherwise request gigabytes and throw bad_alloc across
+    // the C ABI. stored_len must fit in the remaining file; raw_len is
+    // capped at a sane multiple of the stored bytes.
+    long here = ftell(f);
+    fseek(f, 0, SEEK_END);
+    long remain = ftell(f) - here;
+    fseek(f, here, SEEK_SET);
+    if (static_cast<long>(head[4]) > remain ||
+        head[3] > (1u << 30) ||
+        (head[1] == kZlib && head[4] > 0 && head[3] / head[4] > 1024)) {
+      error = 1;
+      return false;
+    }
+    std::string stored(head[4], '\0');
+    if (!stored.empty() && fread(&stored[0], stored.size(), 1, f) != 1) {
+      error = 1;  // header promised a payload that isn't there: truncated
+      return false;
+    }
     uint32_t crc = crc32(0L, reinterpret_cast<const Bytef*>(stored.data()),
                          stored.size());
-    if (crc != head[5]) return false;
+    if (crc != head[5]) {
+      error = 1;
+      return false;
+    }
     std::string payload;
     if (head[1] == kZlib) {
       payload.resize(head[3]);
@@ -101,6 +133,7 @@ struct Scanner {
       if (uncompress(reinterpret_cast<Bytef*>(&payload[0]), &raw,
                      reinterpret_cast<const Bytef*>(stored.data()),
                      stored.size()) != Z_OK || raw != head[3]) {
+        error = 1;
         return false;
       }
     } else {
@@ -108,11 +141,11 @@ struct Scanner {
     }
     size_t off = 0;
     for (uint32_t i = 0; i < head[2]; ++i) {
-      if (off + 4 > payload.size()) return false;
+      if (off + 4 > payload.size()) { error = 1; return false; }
       uint32_t len;
       std::memcpy(&len, payload.data() + off, 4);
       off += 4;
-      if (off + len > payload.size()) return false;
+      if (off + len > payload.size()) { error = 1; return false; }
       chunk.records.emplace_back(payload.data() + off, len);
       off += len;
     }
@@ -177,6 +210,12 @@ const char* recordio_scanner_next(void* handle, int* len) {
   const std::string& r = s->chunk.records[s->cursor++];
   *len = static_cast<int>(r.size());
   return r.data();
+}
+
+// 1 if the scanner stopped because of corruption (CRC mismatch, bad magic,
+// truncated chunk) rather than clean end-of-file.
+int recordio_scanner_error(void* handle) {
+  return static_cast<Scanner*>(handle)->error;
 }
 
 void recordio_scanner_close(void* handle) {

@@ -81,8 +81,13 @@ def _beam_search_decode(ctx, op_, ins):
         return new_lanes, tok
 
     _, toks_rev = lax.scan(back, lane, jnp.arange(cap))
-    sentences = jnp.swapaxes(jnp.swapaxes(toks_rev[::-1], 0, 1), 1, 2)
-    # [B,K,C]; steps beyond length already hold end_id
+    # toks_rev[i] is the token at step n-1-i, so plain reversal leaves the
+    # (cap - n) invalid (end_id) entries at the FRONT of the time axis when
+    # the TensorArray capacity exceeds the written steps; roll them to the
+    # back so hypotheses start at t=0 and trailing slots are end_id padding.
+    ordered = jnp.roll(toks_rev[::-1], -(cap - n), axis=0)
+    sentences = jnp.swapaxes(jnp.swapaxes(ordered, 0, 1), 1, 2)
+    # [B,K,C]; steps beyond length hold end_id
     if scores_arr is not None:
         last = jnp.maximum(n - 1, 0)
         final_scores = scores_arr.buffer[last]                      # [B,K]

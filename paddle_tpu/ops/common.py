@@ -14,6 +14,46 @@ def to_np_dtype(name: str):
     return np.dtype(name)
 
 
+def mxu_cast(ctx, *xs):
+    """Mixed-precision policy hook for MXU-bound ops (matmul/conv).
+
+    Under AMP (program._amp_dtype, see paddle_tpu/amp.py) float32 operands
+    are cast to the compute dtype (bfloat16 → the MXU's native input type);
+    the call site casts the op result back via the returned restore dtype,
+    so everything downstream (BN statistics, losses, optimizer updates on
+    fp32 master weights) stays float32. On TPU the MXU accumulates bf16
+    products in fp32 internally, but the op's *stored* output is bf16 and
+    is then upcast — each output element is rounded to bf16 once (the same
+    rounding the operands already took; `preferred_element_type=f32` is NOT
+    used because this jax version's conv transpose rule rejects mixed
+    bf16-operand/f32-cotangent convs). The generic vjp-backed grad ops
+    re-trace this lowering, so backward matmuls/convs run bf16 too (the
+    astype vjp casts cotangents bf16-ward on entry and back to fp32 toward
+    the weights).
+
+    TPU-native replacement for the reference's fp16 story
+    (reference: paddle/fluid/platform/float16.h:64) — on TPU the low-precision
+    type is bf16 and no loss scaling is needed (bf16 keeps f32's exponent).
+
+    Returns (cast_operands_tuple, restore_dtype_or_None); call sites do
+    `out = out.astype(restore) if restore is not None else out`.
+
+    Under level O2 the restore dtype is None even after casting: activations
+    stay bf16 end-to-end (halving HBM traffic — the dominant cost on
+    bandwidth-bound chips); norm/loss lowerings locally upcast where
+    statistics need f32.
+    """
+    amp = getattr(ctx, "amp_dtype", None)
+    if not amp:
+        return xs, None
+    cd = jnp.dtype(amp)
+    casted = tuple(x.astype(cd) if x.dtype == jnp.float32 else x for x in xs)
+    if getattr(ctx, "amp_level", "O1") == "O2":
+        return casted, None
+    any_cast = any(c is not x for c, x in zip(casted, xs))
+    return casted, (jnp.float32 if any_cast else None)
+
+
 def broadcast_y_to_x(x, y, axis: int):
     """Paddle elementwise broadcast: align y's dims to x starting at `axis`
     (reference: operators/elementwise_op_function.h). axis==-1 means align to

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .registry import NO_GRAD, op, register
-from .common import (broadcast_y_to_x, in_var, matmul_shape, out_var,
+from .common import (broadcast_y_to_x, in_var, matmul_shape, mxu_cast, out_var,
                      same_as_input, set_out)
 
 
@@ -48,7 +48,10 @@ def _mul(ctx, op_, ins):
     y = jnp.asarray(ins["Y"][0])
     xn = op_.attr("x_num_col_dims", 1)
     yn = op_.attr("y_num_col_dims", 1)
-    out2d = _flat2(x, xn) @ _flat2(y, yn)
+    (xf, yf), restore = mxu_cast(ctx, _flat2(x, xn), _flat2(y, yn))
+    out2d = jnp.matmul(xf, yf)
+    if restore is not None:
+        out2d = out2d.astype(restore)
     out_shape = x.shape[:xn] + y.shape[yn:]
     return {"Out": [out2d.reshape(out_shape)]}
 
@@ -72,7 +75,10 @@ def _matmul(ctx, op_, ins):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if op_.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    (x, y), restore = mxu_cast(ctx, x, y)
     out = jnp.matmul(x, y)
+    if restore is not None:
+        out = out.astype(restore)
     alpha = op_.attr("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha
@@ -92,9 +98,12 @@ def _bilinear_tensor_product(ctx, op_, ins):
     x = jnp.asarray(ins["X"][0])      # (B, M)
     y = jnp.asarray(ins["Y"][0])      # (B, N)
     w = jnp.asarray(ins["Weight"][0])  # (O, M, N)
+    (x, y, w), restore = mxu_cast(ctx, x, y, w)
     out = jnp.einsum("bm,omn,bn->bo", x, w, y)
+    if restore is not None:
+        out = out.astype(restore)
     if ins.get("Bias") and ins["Bias"][0] is not None:
-        out = out + jnp.asarray(ins["Bias"][0])
+        out = out + jnp.asarray(ins["Bias"][0]).astype(out.dtype)
     return {"Out": [out]}
 
 
@@ -121,6 +130,14 @@ def _make_ew(fn):
     def lower(ctx, op_, ins):
         x = jnp.asarray(ins["X"][0])
         y = broadcast_y_to_x(x, ins["Y"][0], op_.attr("axis", -1))
+        # AMP O2: an f32 operand (e.g. a master-weight bias) must not
+        # promote a bf16 activation back to f32 — that would silently
+        # re-materialize f32 tensors at every fc/conv bias add and forfeit
+        # the halved HBM traffic. The cast is in-trace, so the bias grad
+        # flows back to the f32 master copy through the astype vjp.
+        if getattr(ctx, "amp_level", "O1") == "O2" and \
+                x.dtype == jnp.bfloat16 and y.dtype == jnp.float32:
+            y = y.astype(x.dtype)
         return {"Out": [fn(x, y)]}
     return lower
 

@@ -32,7 +32,9 @@ def _lr(ins):
 @op("sgd", grad=NO_GRAD, infer_shape=_param_out_infer(("Param", "ParamOut")))
 def _sgd(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     return {"ParamOut": [p - _lr(ins) * g]}
 
 
@@ -41,7 +43,9 @@ def _sgd(ctx, op_, ins):
                                  ("Velocity", "VelocityOut")))
 def _momentum(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     v = jnp.asarray(ins["Velocity"][0])
     mu = op_.attr("mu")
     v_out = mu * v + g
@@ -57,7 +61,9 @@ def _momentum(ctx, op_, ins):
                                  ("Moment2", "Moment2Out")))
 def _adam(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     m1 = jnp.asarray(ins["Moment1"][0])
     m2 = jnp.asarray(ins["Moment2"][0])
     b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
@@ -77,7 +83,9 @@ def _adam(ctx, op_, ins):
                                  ("InfNorm", "InfNormOut")))
 def _adamax(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     m = jnp.asarray(ins["Moment"][0])
     u = jnp.asarray(ins["InfNorm"][0])
     b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
@@ -94,7 +102,9 @@ def _adamax(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
 def _adagrad(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     m = jnp.asarray(ins["Moment"][0])
     eps = op_.attr("epsilon", 1e-6)
     mo = m + g * g
@@ -106,7 +116,9 @@ def _adagrad(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
 def _decayed_adagrad(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     m = jnp.asarray(ins["Moment"][0])
     decay = op_.attr("decay", 0.95)
     eps = op_.attr("epsilon", 1e-6)
@@ -121,7 +133,9 @@ def _decayed_adagrad(ctx, op_, ins):
                                  ("AvgSquaredUpdate", "AvgSquaredUpdateOut")))
 def _adadelta(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     ag = jnp.asarray(ins["AvgSquaredGrad"][0])
     au = jnp.asarray(ins["AvgSquaredUpdate"][0])
     rho = op_.attr("rho", 0.95)
@@ -138,7 +152,9 @@ def _adadelta(ctx, op_, ins):
                                  ("MeanSquare", "MeanSquareOut")))
 def _rmsprop(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     mom = jnp.asarray(ins["Moment"][0])
     ms = jnp.asarray(ins["MeanSquare"][0])
     rho = op_.attr("decay", 0.9)
@@ -155,7 +171,9 @@ def _rmsprop(ctx, op_, ins):
                                  ("LinearAccumulator", "LinearAccumOut")))
 def _ftrl(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     sq = jnp.asarray(ins["SquaredAccumulator"][0])
     lin = jnp.asarray(ins["LinearAccumulator"][0])
     l1 = op_.attr("l1", 0.0)
@@ -182,7 +200,9 @@ def _ftrl(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut")))
 def _proximal_gd(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     l1 = op_.attr("l1", 0.0)
     l2 = op_.attr("l2", 0.0)
     lr = _lr(ins)
@@ -196,7 +216,9 @@ def _proximal_gd(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
 def _proximal_adagrad(ctx, op_, ins):
     p = jnp.asarray(ins["Param"][0])
-    g = jnp.asarray(ins["Grad"][0])
+    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
+    # any arithmetic so lr*g and accumulators stay full precision
+    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
     m = jnp.asarray(ins["Moment"][0])
     l1 = op_.attr("l1", 0.0)
     l2 = op_.attr("l2", 0.0)

@@ -45,6 +45,8 @@ def _load() -> ctypes.CDLL:
     lib.recordio_scanner_next.restype = ctypes.POINTER(ctypes.c_char)
     lib.recordio_scanner_next.argtypes = [ctypes.c_void_p,
                                           ctypes.POINTER(ctypes.c_int)]
+    lib.recordio_scanner_error.restype = ctypes.c_int
+    lib.recordio_scanner_error.argtypes = [ctypes.c_void_p]
     lib.recordio_scanner_close.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
@@ -92,6 +94,13 @@ class RecordIOScanner:
         while True:
             p = lib.recordio_scanner_next(self._h, ctypes.byref(n))
             if not p:
+                # distinguish clean EOF from mid-file corruption: the
+                # reference raises on a bad chunk rather than silently
+                # yielding a truncated dataset
+                if lib.recordio_scanner_error(self._h):
+                    raise IOError(
+                        "recordio stream ended on a corrupted chunk "
+                        "(CRC mismatch, bad magic, or truncated file)")
                 break
             yield ctypes.string_at(p, n.value)
 

@@ -14,7 +14,7 @@ START, END = 0, 1
 H = 16
 
 
-def build_decode_program():
+def build_decode_program(capacity=MAX_LEN + 1):
     src = fluid.layers.data(name="src", shape=[1], dtype="int64",
                             lod_level=1)
     src_emb = fluid.layers.embedding(input=src, size=[V, H])
@@ -33,11 +33,11 @@ def build_decode_program():
             input=enc, shape=[-1, K], dtype="float32", value=0.0),
         lane_penalty, axis=1)
 
-    ids_arr = fluid.layers.array_write(init_ids, counter, capacity=MAX_LEN + 1)
+    ids_arr = fluid.layers.array_write(init_ids, counter, capacity=capacity)
     parents_arr = fluid.layers.array_write(
-        fluid.layers.cast(init_ids, "int32"), counter, capacity=MAX_LEN + 1)
+        fluid.layers.cast(init_ids, "int32"), counter, capacity=capacity)
     scores_arr = fluid.layers.array_write(init_scores, counter,
-                                          capacity=MAX_LEN + 1)
+                                          capacity=capacity)
 
     pre_ids = fluid.layers.assign(init_ids)
     pre_scores = fluid.layers.assign(init_scores)
@@ -86,3 +86,30 @@ def test_beam_search_decode():
     assert (np.diff(out_scores, axis=1) <= 1e-5).all()
     # every hypothesis starts from the START bootstrap lane
     assert (out_ids[:, :, 0] == START).all()
+
+
+def test_beam_search_decode_slack_capacity():
+    """TensorArray capacity larger than the written steps must not shift
+    hypotheses: real tokens start at t=0, trailing slots are end_id padding
+    (regression: the backtrack scan used to leave the (cap-n) invalid
+    entries at the FRONT of the time axis)."""
+    src, sentences, final_scores = build_decode_program(
+        capacity=MAX_LEN + 5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    from paddle_tpu.executor import LoDTensor
+    rows = [np.random.RandomState(i).randint(2, V, (3, 1)).astype(np.int64)
+            for i in range(3)]
+    flat = np.concatenate(rows, 0)
+    offs = [0, 3, 6, 9]
+    out_ids, out_scores = exe.run(
+        fluid.default_main_program(),
+        feed={"src": LoDTensor(flat, [offs])},
+        fetch_list=[sentences, final_scores])
+
+    # hypotheses start with the real first token (the START bootstrap lane),
+    # not with end_id slack
+    assert (out_ids[:, :, 0] == START).all()
+    # slack slots beyond the written steps are end_id padding at the BACK
+    assert (out_ids[:, :, MAX_LEN + 1:] == END).all()

@@ -4,11 +4,23 @@ translation)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU platform even when the ambient environment points jax at an
+# accelerator (e.g. JAX_PLATFORMS=axon): the suite's multi-device tests need
+# the 8 virtual host devices, and a setdefault would silently leave them on
+# one real chip. Override with PADDLE_TPU_TEST_PLATFORM to run elsewhere.
+# jax may be preloaded by the environment, in which case JAX_PLATFORMS was
+# already read at import time — jax.config.update is the reliable path;
+# XLA_FLAGS is read later, at backend init, so the env var suffices for it.
+_platform = os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

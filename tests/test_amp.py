@@ -1,0 +1,117 @@
+"""Mixed-precision (bf16 compute / fp32 master weights) tests — amp.py +
+ops.common.mxu_cast (TPU-native replacement for the reference's fp16 path,
+reference platform/float16.h:64)."""
+
+import jax
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as em
+
+RNG = np.random.default_rng(11)
+
+
+def _build_convnet(main, startup, seed=99):
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 16, 16],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                                act="relu")
+        p = fluid.layers.pool2d(c, pool_size=2, pool_stride=2)
+        logits = fluid.layers.fc(input=p, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(
+            loss, startup_program=startup)
+    return loss
+
+
+def _run(amp, steps=3):
+    from paddle_tpu.framework import unique_name
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    loss = _build_convnet(main, startup)
+    if amp:
+        fluid.amp.enable(main)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = em.Scope()
+    losses, params = [], {}
+    with em.scope_guard(scope):
+        exe.run(startup)
+        feeds = [(RNG.standard_normal((8, 3, 16, 16)).astype(np.float32),
+                  RNG.integers(0, 4, (8, 1)).astype(np.int64))
+                 for _ in range(steps)]
+        for xv, yv in feeds:
+            lv, = exe.run(main, feed={"img": xv, "label": yv},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+        for n in scope.local_var_names():
+            v = scope.find_var(n)
+            if n.endswith(".w_0"):
+                params[n] = v
+    return losses, params
+
+
+def test_amp_close_to_fp32_and_master_weights_stay_fp32():
+    global RNG
+    RNG = np.random.default_rng(11)
+    loss_fp32, _ = _run(amp=False)
+    RNG = np.random.default_rng(11)
+    loss_amp, params = _run(amp=True)
+
+    # bf16 operand rounding gives ~1e-2 relative agreement on a tiny net
+    np.testing.assert_allclose(loss_fp32, loss_amp, rtol=0.05, atol=0.02)
+    # master weights (and their updates) stay float32
+    assert params and all(
+        np.asarray(v).dtype == np.float32 for v in params.values())
+
+
+def test_amp_decorate_tags_program():
+    main, startup = fluid.Program(), fluid.Program()
+    main_l = _build_convnet(main, startup)
+    with fluid.program_guard(main, startup):
+        pass
+    opt = fluid.amp.decorate(fluid.optimizer.SGD(learning_rate=0.1))
+    assert getattr(main, "_amp_dtype", None) is None
+    # decorate().minimize on a fresh program tags it
+    from paddle_tpu.framework import unique_name
+    unique_name.switch()
+    m2, s2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m2, s2):
+        img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=img, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt.minimize(loss, startup_program=s2)
+    assert m2._amp_dtype == "bfloat16"
+
+
+def test_amp_bf16_in_compiled_hlo():
+    """The compiled train step must actually contain bf16 convolutions —
+    guard against the policy silently not applying."""
+    from paddle_tpu.framework import unique_name
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    loss = _build_convnet(main, startup)
+    fluid.amp.enable(main)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = em.Scope()
+    with em.scope_guard(scope):
+        exe.run(startup)
+        xv = RNG.standard_normal((8, 3, 16, 16)).astype(np.float32)
+        yv = RNG.integers(0, 4, (8, 1)).astype(np.int64)
+        exe.run(main, feed={"img": xv, "label": yv}, fetch_list=[loss])
+        # the training-step entry is the one with persistable state;
+        # the other cache entry is the startup program
+        import jax.numpy as jnp
+        cb = [c for c in exe._cache.values() if c.state_names][0]
+        txt = str(cb.fn.lower(
+            {"img": jnp.zeros((8, 3, 16, 16), jnp.float32),
+             "label": jnp.zeros((8, 1), jnp.int32)},
+            {n: jnp.asarray(scope.find_var(n)) for n in cb.state_names},
+            jax.random.key(0)).as_text())
+    import re
+    assert re.search(r"convolution.*bf16", txt), "no bf16 convolutions"

@@ -48,5 +48,8 @@ def test_crc_detects_corruption(tmp_path):
     raw = bytearray(open(path, "rb").read())
     raw[len(raw) // 2] ^= 0xFF
     open(path, "wb").write(bytes(raw))
-    got = list(recordio.RecordIOScanner(path))
-    assert got == []  # corrupted chunk rejected, not silently returned
+    # a corrupted chunk must raise, not silently truncate the dataset
+    # (reference scanner raises on CRC mismatch)
+    import pytest
+    with pytest.raises(IOError):
+        list(recordio.RecordIOScanner(path))
