@@ -6,6 +6,7 @@ from .tensor import *        # noqa: F401,F403
 from .nn import *            # noqa: F401,F403
 from .sequence import *      # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
+from . import detection     # noqa: F401
 from . import ops as _ops_module
 from .ops import *           # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403

@@ -19,6 +19,9 @@ __all__ = [
     "While", "StaticRNN", "DynamicRNN", "IfElse", "Switch",
     "ConditionalBlock", "array_read", "array_write", "array_length",
     "create_array", "increment", "less_than", "equal", "zeros_like",
+    "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
+    "array_to_lod_tensor", "split_lod_tensor", "merge_lod_tensor",
+    "reorder_lod_tensor_by_rank", "shrink_memory",
 ]
 
 
@@ -430,3 +433,95 @@ class DynamicRNN(_RNNBase):
 
     def __init__(self, name=None):
         super().__init__("dynamic_rnn", name=name)
+
+
+# --- LoD-array plumbing (reference control_flow.py:665,888-1058) --------------
+
+def lod_rank_table(x, level=0):
+    """Sorted (index, length) table over a sequence batch (reference
+    control_flow.py:665, lod_rank_table.cc); in the padded lowering this is
+    the lengths vector riding the @SEQLEN channel."""
+    helper = LayerHelper("lod_rank_table")
+    table = helper.create_tmp_variable("int32")
+    helper.append_op(type="lod_rank_table", inputs={"X": [x]},
+                     outputs={"Out": [table]}, attrs={"level": level})
+    return table
+
+
+def max_sequence_len(rank_table):
+    """Max sequence length from a rank table (reference control_flow.py:704)."""
+    helper = LayerHelper("max_sequence_len")
+    out = helper.create_tmp_variable("int64")
+    helper.append_op(type="max_sequence_len",
+                     inputs={"RankTable": [rank_table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    """Split a sequence batch into a time-major TensorArray (reference
+    control_flow.py:888)."""
+    helper = LayerHelper("lod_tensor_to_array")
+    array = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="lod_tensor_to_array",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [array]}, attrs={})
+    return array
+
+
+def array_to_lod_tensor(x, table):
+    """Stack a TensorArray back into a padded sequence batch (reference
+    control_flow.py:919)."""
+    helper = LayerHelper("array_to_lod_tensor")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="array_to_lod_tensor",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def split_lod_tensor(input, mask, level=0):
+    """Row-route a batch by boolean mask (reference control_flow.py:943).
+    Returns (in_true, in_false); dense lowering keeps row positions."""
+    helper = LayerHelper("split_lod_tensor")
+    out_true = helper.create_tmp_variable(input.dtype)
+    out_false = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="split_lod_tensor",
+                     inputs={"X": [input], "Mask": [mask]},
+                     outputs={"OutTrue": [out_true], "OutFalse": [out_false]},
+                     attrs={"level": level})
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0):
+    """Inverse of split_lod_tensor (reference control_flow.py:997)."""
+    helper = LayerHelper("merge_lod_tensor")
+    out = helper.create_tmp_variable(in_true.dtype)
+    helper.append_op(type="merge_lod_tensor",
+                     inputs={"InTrue": [in_true], "InFalse": [in_false],
+                             "Mask": [mask], "X": [x]},
+                     outputs={"Out": [out]}, attrs={"level": level})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Reorder sequences into rank-table (descending length) order
+    (reference control_flow.py:1058)."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="reorder_lod_tensor_by_rank",
+                     inputs={"X": [x], "RankTable": [rank_table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def shrink_memory(x, i, table):
+    """Batch-shrink an RNN state to live sequences (reference
+    control_flow.py:732, shrink_rnn_memory_op.cc); dense lowering is a
+    pass-through — masking in the scan supplies the semantics."""
+    helper = LayerHelper("shrink_memory")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="shrink_rnn_memory",
+                     inputs={"X": [x], "I": [i], "RankTable": [table]},
+                     outputs={"Out": [out]}, attrs={})
+    return out

@@ -27,6 +27,10 @@ __all__ = [
     "scatter", "pad", "nce", "row_conv", "im2sequence", "multiplex",
     "sigmoid_cross_entropy_with_logits", "maxout",
     "linear_chain_crf", "crf_decoding", "beam_search", "beam_search_decode",
+    "warpctc", "ctc_greedy_decoder", "ctc_align", "edit_distance", "chunk_eval",
+    "precision_recall", "positive_negative_pair", "pool3d", "roi_pool",
+    "prelu", "crop", "spp", "unpool", "conv3d_transpose",
+    "max_pool2d_with_index", "conv_shift", "l1_norm",
 ]
 
 
@@ -748,3 +752,291 @@ def beam_search_decode(ids, parent_idx, scores=None, beam_size=None,
                               "SentenceScores": [sentence_scores]},
                      attrs={"end_id": end_id})
     return sentence_ids, sentence_scores
+
+
+# --- CTC / sequence metrics ---------------------------------------------------
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss over padded-LoD logits (reference nn.py:2696, warpctc_op.cc;
+    softmax applied internally). Returns [num_sequences, 1] loss."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="warpctc",
+                     inputs={"Logits": [input], "Label": [label]},
+                     outputs={"Loss": [loss]},
+                     attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """Greedy CTC decode: per-step argmax, then merge repeats + drop blanks
+    (reference nn.py:2616: top_k -> ctc_align)."""
+    helper = LayerHelper("ctc_greedy_decoder")
+    _, idx = topk(input, k=1)
+    out = helper.create_tmp_variable(idx.dtype)
+    helper.append_op(type="ctc_align", inputs={"Input": [idx]},
+                     outputs={"Output": [out]},
+                     attrs={"blank": blank, "merge_repeated": True})
+    return out
+
+
+def ctc_align(input, blank=0, merge_repeated=True):
+    """Raw ctc_align on an id sequence (reference ctc_align_op.h)."""
+    helper = LayerHelper("ctc_align")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="ctc_align", inputs={"Input": [input]},
+                     outputs={"Output": [out]},
+                     attrs={"blank": blank, "merge_repeated": merge_repeated})
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  name=None):
+    """Levenshtein distance per sequence pair (reference nn.py:2534,
+    edit_distance_op.h). Returns (distance [B,1], sequence_num [1])."""
+    helper = LayerHelper("edit_distance")
+    if ignored_tokens is not None and len(ignored_tokens) > 0:
+        erased_input = helper.create_tmp_variable(input.dtype)
+        erased_label = helper.create_tmp_variable(label.dtype)
+        helper.append_op(type="sequence_erase", inputs={"X": [input]},
+                         outputs={"Out": [erased_input]},
+                         attrs={"tokens": list(ignored_tokens)})
+        helper.append_op(type="sequence_erase", inputs={"X": [label]},
+                         outputs={"Out": [erased_label]},
+                         attrs={"tokens": list(ignored_tokens)})
+        input, label = erased_input, erased_label
+    out = helper.create_tmp_variable("float32")
+    seq_num = helper.create_tmp_variable("int32")
+    helper.append_op(type="edit_distance",
+                     inputs={"Hyps": [input], "Refs": [label]},
+                     outputs={"Out": [out], "SequenceNum": [seq_num]},
+                     attrs={"normalized": normalized})
+    return out, seq_num
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    """Chunking precision/recall/F1 (reference nn.py:1015, chunk_eval_op.h).
+    Returns (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks)."""
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_tmp_variable("float32")
+    recall = helper.create_tmp_variable("float32")
+    f1_score = helper.create_tmp_variable("float32")
+    num_infer_chunks = helper.create_tmp_variable("int32")
+    num_label_chunks = helper.create_tmp_variable("int32")
+    num_correct_chunks = helper.create_tmp_variable("int32")
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label]},
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1_score],
+                 "NumInferChunks": [num_infer_chunks],
+                 "NumLabelChunks": [num_label_chunks],
+                 "NumCorrectChunks": [num_correct_chunks]},
+        attrs={"num_chunk_types": num_chunk_types,
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    return (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+            num_correct_chunks)
+
+
+def precision_recall(indices, labels, class_number, weights=None,
+                     states_info=None):
+    """Multi-class precision/recall metrics op wrapper (reference
+    precision_recall_op.h). Returns (batch_metrics [6], accum_metrics [6],
+    accum_states_info [C,4])."""
+    helper = LayerHelper("precision_recall")
+    batch_metrics = helper.create_tmp_variable("float32")
+    accum_metrics = helper.create_tmp_variable("float32")
+    accum_states = helper.create_tmp_variable("float32")
+    inputs = {"Indices": [indices], "Labels": [labels]}
+    if weights is not None:
+        inputs["Weights"] = [weights]
+    if states_info is not None:
+        inputs["StatesInfo"] = [states_info]
+    helper.append_op(type="precision_recall", inputs=inputs,
+                     outputs={"BatchMetrics": [batch_metrics],
+                              "AccumMetrics": [accum_metrics],
+                              "AccumStatesInfo": [accum_states]},
+                     attrs={"class_number": class_number})
+    return batch_metrics, accum_metrics, accum_states
+
+
+def positive_negative_pair(score, label, query_id, weight=None, column=-1):
+    """Ranking pair counts per query (reference positive_negative_pair_op.h).
+    Returns (positive_pair, negative_pair, neutral_pair)."""
+    helper = LayerHelper("positive_negative_pair")
+    pos, neg, neu = (helper.create_tmp_variable("float32") for _ in range(3))
+    inputs = {"Score": [score], "Label": [label], "QueryID": [query_id]}
+    if weight is not None:
+        inputs["Weight"] = [weight]
+    helper.append_op(type="positive_negative_pair", inputs=inputs,
+                     outputs={"PositivePair": [pos], "NegativePair": [neg],
+                              "NeutralPair": [neu]},
+                     attrs={"column": column})
+    return pos, neg, neu
+
+
+# --- vision layer wrappers ----------------------------------------------------
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False, name=None):
+    """NCDHW pooling (reference pool_op.cc pool3d)."""
+    def _t(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v, v]
+    helper = LayerHelper("pool3d")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="pool3d", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type,
+                            "ksize": _t(pool_size),
+                            "strides": _t(pool_stride),
+                            "paddings": _t(pool_padding),
+                            "global_pooling": global_pooling,
+                            "ceil_mode": ceil_mode})
+    return out
+
+
+def max_pool2d_with_index(input, pool_size, pool_stride=1, pool_padding=0):
+    """Max pool returning (out, argmax-mask) (reference
+    pool_with_index_op.cc)."""
+    helper = LayerHelper("max_pool2d_with_index")
+    out = helper.create_tmp_variable(input.dtype)
+    mask = helper.create_tmp_variable("int32")
+    helper.append_op(type="max_pool2d_with_index", inputs={"X": [input]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"ksize": _pair(pool_size),
+                            "strides": _pair(pool_stride),
+                            "paddings": _pair(pool_padding)})
+    return out, mask
+
+
+def unpool(input, indices, unpooled_size):
+    """Max unpooling from argmax indices (reference unpool_op.cc)."""
+    helper = LayerHelper("unpool")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="unpool",
+                     inputs={"X": [input], "Indices": [indices]},
+                     outputs={"Out": [out]},
+                     attrs={"unpooled_size": list(unpooled_size)})
+    return out
+
+
+def spp(input, pyramid_height, pool_type="max"):
+    """Spatial pyramid pooling (reference spp_op.cc)."""
+    helper = LayerHelper("spp")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="spp", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"pyramid_height": pyramid_height,
+                            "pooling_type": pool_type})
+    return out
+
+
+def roi_pool(input, rois, pooled_height, pooled_width, spatial_scale=1.0,
+             rois_batch_id=None):
+    """ROI max pooling (reference roi_pool_op.cc)."""
+    helper = LayerHelper("roi_pool")
+    out = helper.create_tmp_variable(input.dtype)
+    argmax = helper.create_tmp_variable("int32")
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch_id is not None:
+        inputs["RoiBatchId"] = [rois_batch_id]
+    helper.append_op(type="roi_pool", inputs=inputs,
+                     outputs={"Out": [out], "Argmax": [argmax]},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Crop x to shape at offsets (reference crop_op.cc); shape/offsets may
+    be lists or Variables."""
+    helper = LayerHelper("crop")
+    out = helper.create_tmp_variable(x.dtype)
+    inputs = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, Variable):
+        inputs["Y"] = [shape]
+    else:
+        attrs["shape"] = list(shape)
+    if isinstance(offsets, Variable):
+        inputs["Offsets"] = [offsets]
+    elif offsets is not None:
+        attrs["offsets"] = list(offsets)
+    helper.append_op(type="crop", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """Parametric ReLU with learned alpha (reference prelu_op.cc)."""
+    helper = LayerHelper("prelu", param_attr=param_attr)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape)
+    from ..initializer import Constant
+    alpha = helper.create_parameter(attr=helper.param_attr,
+                                    shape=alpha_shape, dtype=x.dtype,
+                                    default_initializer=Constant(0.25))
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="prelu",
+                     inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, param_attr=None,
+                     bias_attr=None, act=None, name=None):
+    """Transposed 3D convolution (reference conv_transpose_op.cc)."""
+    def _t(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v, v, v]
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act)
+    cin = input.shape[1]
+    stride_, padding_, dilation_ = _t(stride), _t(padding), _t(dilation)
+    if filter_size is None:
+        assert output_size is not None, \
+            "conv3d_transpose needs filter_size or output_size"
+        output_size = _t(output_size)
+        filter_size = [
+            (output_size[i] - (input.shape[2 + i] - 1) * stride_[i]
+             + 2 * padding_[i] - 1) // dilation_[i] + 1
+            for i in range(3)]
+    else:
+        filter_size = _t(filter_size)
+    f = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[cin, num_filters] + filter_size, dtype=input.dtype)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="conv3d_transpose",
+                     inputs={"Input": [input], "Filter": [f]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride_, "paddings": padding_,
+                            "dilations": dilation_})
+    out = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(out)
+
+
+def conv_shift(x, y, name=None):
+    """Circular convolution (reference conv_shift_op.cc)."""
+    helper = LayerHelper("conv_shift")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="conv_shift", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def l1_norm(x, name=None):
+    """Sum of absolute values (reference l1_norm_op.cc)."""
+    helper = LayerHelper("l1_norm")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="l1_norm", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={})
+    return out

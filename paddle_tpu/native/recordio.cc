@@ -111,7 +111,7 @@ struct Scanner {
     fseek(f, here, SEEK_SET);
     if (static_cast<long>(head[4]) > remain ||
         head[3] > (1u << 30) ||
-        (head[1] == kZlib && head[4] > 0 && head[3] / head[4] > 1024)) {
+        (head[1] == kZlib && head[4] > 0 && head[3] / head[4] > 1200)) {
       error = 1;
       return false;
     }

@@ -79,6 +79,16 @@ def broadcast_y_to_x(x, y, axis: int):
     return y.reshape(new_shape)
 
 
+def seq_lengths(ctx, op_, slot, batch, cap):
+    """Valid per-sequence lengths for a padded input slot: the @SEQLEN side
+    channel when the var is a LoD feed, else the full padded extent."""
+    names = op_.desc.inputs.get(slot, [])
+    lens = ctx.seq_len(names[0]) if names else None
+    if lens is None:
+        return jnp.full((batch,), cap, dtype=jnp.int32)
+    return jnp.asarray(lens).astype(jnp.int32)
+
+
 # --- shape inference helpers ------------------------------------------------
 
 def out_var(op, block, slot="Out", idx=0):

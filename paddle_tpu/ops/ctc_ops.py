@@ -1,0 +1,198 @@
+"""CTC family: warpctc loss, ctc_align greedy decode, edit_distance.
+
+TPU-native replacements for the reference's warp-ctc dynload + CPU kernels
+(reference: warpctc_op.cc/.h — dynloaded Baidu warp-ctc library;
+ctc_align_op.h; edit_distance_op.h). Instead of a vendored CUDA library the
+CTC forward algorithm runs in-graph as a `lax.scan` over time in log space
+— differentiable by construction, so the gradient comes from the generic
+vjp kernel instead of warp-ctc's hand-written backward, and the whole loss
+fuses into the model's single XLA computation. Sequences follow the
+padded-dense + @SEQLEN convention (the LoD emulation)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import in_var, seq_lengths as _lengths, set_out
+from .registry import NO_GRAD, op
+
+_NEG_INF = -1e30
+
+
+def _ctc_loss_one(logp, labels, t_len, l_len, blank):
+    """CTC forward (alpha) recursion for one sequence in log space.
+
+    logp: [T, C] log-softmax scores; labels: [L] int32 (padded);
+    t_len/l_len: valid lengths. Returns -log p(labels | logp)."""
+    t_max, _ = logp.shape
+    l_max = labels.shape[0]
+    s_max = 2 * l_max + 1
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    z = jnp.full((s_max,), blank, dtype=jnp.int32).at[1::2].set(labels)
+    pos = jnp.arange(s_max)
+    # skip transition s-2 -> s allowed where z[s] != blank and z[s] != z[s-2]
+    z_m2 = jnp.roll(z, 2)
+    allow_skip = (z != blank) & (z != z_m2) & (pos >= 2)
+
+    alpha0 = jnp.full((s_max,), _NEG_INF)
+    alpha0 = alpha0.at[0].set(logp[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(l_len > 0, logp[0, z[1]], _NEG_INF))
+
+    def step(alpha, xs):
+        logp_t, t = xs
+        a1 = alpha
+        a2 = jnp.concatenate([jnp.array([_NEG_INF]), alpha[:-1]])
+        a3 = jnp.where(allow_skip,
+                       jnp.concatenate([jnp.full((2,), _NEG_INF), alpha[:-2]]),
+                       _NEG_INF)
+        m = jnp.maximum(jnp.maximum(a1, a2), a3)
+        tot = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m) + jnp.exp(a3 - m))
+        new = tot + logp_t[z]
+        # freeze alpha once past this sequence's end so the final carry is
+        # alpha at t = t_len-1 (the LoD emulation of per-sequence T)
+        new = jnp.where(t < t_len, new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0,
+                            (logp[1:], jnp.arange(1, t_max)))
+    end1 = alpha[2 * l_len]
+    end2 = jnp.where(l_len > 0, alpha[jnp.maximum(2 * l_len - 1, 0)], _NEG_INF)
+    m = jnp.maximum(end1, end2)
+    return -(m + jnp.log(jnp.exp(end1 - m) + jnp.exp(end2 - m)))
+
+
+def _warpctc_infer(op_, block):
+    xv = in_var(op_, block, "Logits")
+    if xv is not None and xv.shape is not None:
+        set_out(op_, block, "Loss", [xv.shape[0], 1], xv.dtype)
+
+
+@op("warpctc", infer_shape=_warpctc_infer, non_diff_inputs=("Label",))
+def _warpctc(ctx, op_, ins):
+    """CTC loss (reference warpctc_op.cc, via the dynloaded warp-ctc lib).
+    Logits are padded [B, T, C] (+ @SEQLEN), Label padded [B, L] int
+    (+ @SEQLEN). Softmax is applied internally, like warp-ctc. With
+    norm_by_times the *gradient* is scaled by 1/T_b (forward loss unchanged),
+    matching the reference's ScaleLoDTensorFunctor on the logits grad."""
+    logits = jnp.asarray(ins["Logits"][0])
+    labels = jnp.asarray(ins["Label"][0]).astype(jnp.int32)
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    b, t, _ = logits.shape
+    t_lens = _lengths(ctx, op_, "Logits", b, t)
+    l_lens = _lengths(ctx, op_, "Label", b, labels.shape[1])
+    blank = op_.attr("blank", 0)
+
+    if op_.attr("norm_by_times", False):
+        s = (1.0 / jnp.maximum(t_lens, 1).astype(logits.dtype))
+        s = s[:, None, None]
+        logits = logits * s + jax.lax.stop_gradient(logits * (1.0 - s))
+
+    logits32 = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits32, axis=-1)
+    loss = jax.vmap(_ctc_loss_one, in_axes=(0, 0, 0, 0, None))(
+        logp, labels, t_lens, l_lens, blank)
+    loss = loss.astype(logits.dtype)[:, None]
+    name = op_.desc.outputs["Loss"][0]
+    ctx.set_seq_len(name, None)   # Loss is [num_seq, 1], not a sequence
+    return {"Loss": [loss]}
+
+
+def _ctc_align_infer(op_, block):
+    xv = in_var(op_, block, "Input")
+    if xv is not None:
+        set_out(op_, block, "Output", xv.shape, xv.dtype)
+
+
+@op("ctc_align", infer_shape=_ctc_align_infer, grad=NO_GRAD)
+def _ctc_align(ctx, op_, ins):
+    """Greedy CTC decode: merge repeats, drop blanks (reference
+    ctc_align_op.h). Input padded [B, T] int (+ @SEQLEN); output padded
+    [B, T] with new per-sequence lengths — compaction is a stable sort on
+    the keep mask, the XLA-friendly form of the reference's sequential
+    copy loop."""
+    x = jnp.asarray(ins["Input"][0])
+    squeeze = x.ndim == 3
+    if squeeze:
+        x = x[..., 0]
+    b, t = x.shape
+    lens = _lengths(ctx, op_, "Input", b, t)
+    blank = op_.attr("blank", 0)
+    merge = op_.attr("merge_repeated", True)
+
+    steps = jnp.arange(t)[None, :]
+    valid = steps < lens[:, None]
+    prev = jnp.concatenate([jnp.full((b, 1), -1, x.dtype), x[:, :-1]], axis=1)
+    keep = (x != blank) & valid
+    if merge:
+        keep = keep & (x != prev)
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    out = jnp.take_along_axis(x, order, axis=1)
+    new_lens = keep.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(steps < new_lens[:, None], out, jnp.zeros_like(out))
+    if squeeze:
+        out = out[..., None]
+    ctx.set_seq_len(op_.desc.outputs["Output"][0], new_lens)
+    return {"Output": [out]}
+
+
+def _edit_distance_one(hyp, ref, m, n):
+    """Levenshtein DP for one (hyp, ref) pair over padded buffers; only the
+    dp[m, n] cell is read, which depends solely on real tokens."""
+    l2 = ref.shape[0]
+    row0 = jnp.arange(l2 + 1, dtype=jnp.float32)
+
+    def outer(prev_row, xs):
+        h_tok, i = xs   # i is 1-based hyp position
+
+        def inner(left, xs2):
+            r_tok, j, up, upleft = xs2
+            cost = jnp.where(h_tok == r_tok, 0.0, 1.0)
+            val = jnp.minimum(jnp.minimum(up + 1.0, left + 1.0),
+                              upleft + cost)
+            return val, val
+
+        _, rest = jax.lax.scan(
+            inner, i.astype(jnp.float32),
+            (ref, jnp.arange(1, l2 + 1), prev_row[1:], prev_row[:-1]))
+        new_row = jnp.concatenate([i.astype(jnp.float32)[None], rest])
+        return new_row, new_row
+
+    _, rows = jax.lax.scan(outer, row0,
+                           (hyp, jnp.arange(1, hyp.shape[0] + 1)))
+    all_rows = jnp.concatenate([row0[None], rows], axis=0)
+    return all_rows[m, n]
+
+
+def _edit_distance_infer(op_, block):
+    hv = in_var(op_, block, "Hyps")
+    if hv is not None and hv.shape is not None:
+        set_out(op_, block, "Out", [hv.shape[0], 1], "float32")
+        set_out(op_, block, "SequenceNum", [1], "int32")
+
+
+@op("edit_distance", infer_shape=_edit_distance_infer, grad=NO_GRAD)
+def _edit_distance(ctx, op_, ins):
+    """Levenshtein distance between hypothesis and reference id sequences
+    (reference edit_distance_op.h). Padded [B, L] ints + @SEQLEN each side;
+    Out is [B, 1] float, optionally normalized by the reference length."""
+    hyp = jnp.asarray(ins["Hyps"][0])
+    ref = jnp.asarray(ins["Refs"][0])
+    if hyp.ndim == 3:
+        hyp = hyp[..., 0]
+    if ref.ndim == 3:
+        ref = ref[..., 0]
+    b = hyp.shape[0]
+    m = _lengths(ctx, op_, "Hyps", b, hyp.shape[1])
+    n = _lengths(ctx, op_, "Refs", b, ref.shape[1])
+    dist = jax.vmap(_edit_distance_one)(hyp, ref, m, n)
+    dist = jnp.where(m == 0, n.astype(jnp.float32), dist)
+    dist = jnp.where((n == 0) & (m != 0), m.astype(jnp.float32), dist)
+    if op_.attr("normalized", False):
+        dist = dist / jnp.maximum(n, 1).astype(jnp.float32)
+    for name in op_.desc.outputs.get("Out", []):
+        ctx.set_seq_len(name, None)
+    return {"Out": [dist[:, None]],
+            "SequenceNum": [jnp.array([b], dtype=jnp.int32)]}

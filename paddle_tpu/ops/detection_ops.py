@@ -1,0 +1,599 @@
+"""SSD detection op family: prior_box, iou_similarity, box_coder,
+bipartite_match, mine_hard_examples, target_assign, multiclass_nms,
+detection_map.
+
+TPU-native lowerings of the reference CPU-only detection kernels
+(reference: prior_box_op.h, iou_similarity_op.h, box_coder_op.h,
+bipartite_match_op.cc, mine_hard_examples_op.cc, target_assign_op.h,
+multiclass_nms_op.cc, detection_map_op.h). The reference routes these to
+CPU with data-dependent loops and dynamic output shapes; here everything is
+fixed-shape: batches are padded [B, G, ...] with @SEQLEN counts, greedy
+matching/NMS run as bounded `lax.fori_loop`s over sorted candidates, and
+selection results are compacted by stable sort on keep masks. detection_map
+stays a host callback (like the reference's CPU-only kernel) because mAP is
+a once-per-batch metric with inherently sequential per-class accumulation.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import in_var, out_var, seq_lengths as _lengths, set_out
+from .registry import NO_GRAD, op
+
+_EPS = 1e-6
+
+
+# --- prior_box ----------------------------------------------------------------
+
+def _expand_aspect_ratios(ars, flip):
+    out = [1.0]
+    for ar in ars:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+def _prior_box_infer(op_, block):
+    iv = in_var(op_, block, "Input")
+    if iv is None or iv.shape is None:
+        return
+    ars = _expand_aspect_ratios(op_.attr("aspect_ratios", [1.0]),
+                                op_.attr("flip", False))
+    num = len(ars) * len(op_.attr("min_sizes")) + \
+        len(op_.attr("max_sizes", []) or [])
+    h, w = iv.shape[2], iv.shape[3]
+    set_out(op_, block, "Boxes", [h, w, num, 4], "float32")
+    set_out(op_, block, "Variances", [h, w, num, 4], "float32")
+
+
+@op("prior_box", infer_shape=_prior_box_infer, grad=NO_GRAD,
+    non_diff_inputs=("Input", "Image"))
+def _prior_box(ctx, op_, ins):
+    """SSD prior (anchor) boxes for one feature map (reference
+    prior_box_op.h). Pure function of static shapes and attrs, so the whole
+    grid is computed in numpy at trace time and embedded as an XLA constant
+    — zero runtime cost."""
+    feat = ins["Input"][0]
+    img = ins["Image"][0]
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    min_sizes = [float(v) for v in op_.attr("min_sizes")]
+    max_sizes = [float(v) for v in (op_.attr("max_sizes", []) or [])]
+    ars = _expand_aspect_ratios(
+        [float(a) for a in op_.attr("aspect_ratios", [1.0])],
+        op_.attr("flip", False))
+    variances = [float(v) for v in op_.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    step_w = float(op_.attr("step_w", 0.0)) or iw / fw
+    step_h = float(op_.attr("step_h", 0.0)) or ih / fh
+    offset = float(op_.attr("offset", 0.5))
+
+    num_priors = len(ars) * len(min_sizes) + len(max_sizes)
+    boxes = np.zeros((fh, fw, num_priors, 4), np.float32)
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            idx = 0
+            for s, ms in enumerate(min_sizes):
+                bw = bh = ms / 2.0
+                boxes[h, w, idx] = [(cx - bw) / iw, (cy - bh) / ih,
+                                    (cx + bw) / iw, (cy + bh) / ih]
+                idx += 1
+                if max_sizes:
+                    bw = bh = math.sqrt(ms * max_sizes[s]) / 2.0
+                    boxes[h, w, idx] = [(cx - bw) / iw, (cy - bh) / ih,
+                                        (cx + bw) / iw, (cy + bh) / ih]
+                    idx += 1
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    bw = ms * math.sqrt(ar) / 2.0
+                    bh = ms / math.sqrt(ar) / 2.0
+                    boxes[h, w, idx] = [(cx - bw) / iw, (cy - bh) / ih,
+                                        (cx + bw) / iw, (cy + bh) / ih]
+                    idx += 1
+    if op_.attr("clip", False):
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(
+        np.asarray(variances, np.float32), boxes.shape).copy()
+    return {"Boxes": [jnp.asarray(boxes)], "Variances": [jnp.asarray(vars_)]}
+
+
+# --- iou_similarity -----------------------------------------------------------
+
+def _iou_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    yv = in_var(op_, block, "Y")
+    if xv is not None and xv.shape is not None and yv is not None \
+            and yv.shape is not None:
+        set_out(op_, block, "Out", [xv.shape[0], yv.shape[0]], xv.dtype)
+
+
+def pairwise_iou(x, y):
+    """IoU between every row of x [..., N, 4] and y [M, 4] -> [..., N, M]."""
+    x = x[..., :, None, :]
+    y = y[None, :, :]
+    ixmin = jnp.maximum(x[..., 0], y[..., 0])
+    iymin = jnp.maximum(x[..., 1], y[..., 1])
+    ixmax = jnp.minimum(x[..., 2], y[..., 2])
+    iymax = jnp.minimum(x[..., 3], y[..., 3])
+    iw = jnp.maximum(ixmax - ixmin, 0.0)
+    ih = jnp.maximum(iymax - iymin, 0.0)
+    inter = iw * ih
+    a1 = (x[..., 2] - x[..., 0]) * (x[..., 3] - x[..., 1])
+    a2 = (y[..., 2] - y[..., 0]) * (y[..., 3] - y[..., 1])
+    union = a1 + a2 - inter
+    return inter / jnp.maximum(union, _EPS)
+
+
+@op("iou_similarity", infer_shape=_iou_infer, non_diff_inputs=("Y",))
+def _iou_similarity(ctx, op_, ins):
+    """Pairwise Jaccard overlap (reference iou_similarity_op.h). X may be a
+    padded LoD batch [B, G, 4] (rows beyond the per-image count produce
+    garbage rows that downstream consumers mask via @SEQLEN) or flat
+    [N, 4]."""
+    x = jnp.asarray(ins["X"][0])
+    y = jnp.asarray(ins["Y"][0])
+    if x.ndim == 3:
+        out = jax.vmap(lambda xb: pairwise_iou(xb, y))(x)
+    else:
+        out = pairwise_iou(x, y)
+    return {"Out": [out]}
+
+
+# --- box_coder ----------------------------------------------------------------
+
+def _box_coder_infer(op_, block):
+    tv = in_var(op_, block, "TargetBox")
+    pv = in_var(op_, block, "PriorBox")
+    if tv is None or tv.shape is None or pv is None or pv.shape is None:
+        return
+    code_type = op_.attr("code_type", "encode_center_size")
+    if code_type == "encode_center_size":
+        set_out(op_, block, "OutputBox",
+                [tv.shape[0], pv.shape[0], 4], tv.dtype)
+    else:
+        set_out(op_, block, "OutputBox", list(tv.shape), tv.dtype)
+
+
+@op("box_coder", infer_shape=_box_coder_infer,
+    non_diff_inputs=("PriorBox", "PriorBoxVar"))
+def _box_coder(ctx, op_, ins):
+    """Encode/decode boxes against priors in center-size form (reference
+    box_coder_op.h). encode: targets [N, 4] x priors [M, 4] -> [N, M, 4];
+    decode: codes [N, M, 4] (or [B, N, M, 4]) -> same shape boxes."""
+    t = jnp.asarray(ins["TargetBox"][0])
+    p = jnp.asarray(ins["PriorBox"][0])
+    pv = jnp.asarray(ins["PriorBoxVar"][0]) if ins.get("PriorBoxVar") and \
+        ins["PriorBoxVar"][0] is not None else jnp.ones_like(p)
+    if pv.ndim > 2:
+        pv = pv.reshape(-1, pv.shape[-1])
+    if p.ndim > 2:
+        p = p.reshape(-1, p.shape[-1])
+    pw = p[:, 2] - p[:, 0]
+    ph = p[:, 3] - p[:, 1]
+    pcx = (p[:, 2] + p[:, 0]) / 2
+    pcy = (p[:, 3] + p[:, 1]) / 2
+
+    if op_.attr("code_type", "encode_center_size") == "encode_center_size":
+        # targets [..., G, 4] x priors [P, 4] -> [..., G, P, 4]
+        tcx = ((t[..., 2] + t[..., 0]) / 2)[..., None]
+        tcy = ((t[..., 3] + t[..., 1]) / 2)[..., None]
+        tw = (t[..., 2] - t[..., 0])[..., None]
+        th = (t[..., 3] - t[..., 1])[..., None]
+        out = jnp.stack([
+            (tcx - pcx) / pw / pv[:, 0],
+            (tcy - pcy) / ph / pv[:, 1],
+            jnp.log(jnp.abs(tw / pw)) / pv[:, 2],
+            jnp.log(jnp.abs(th / ph)) / pv[:, 3],
+        ], axis=-1)
+    else:
+        # decode: t is [..., M, 4] codes aligned with priors
+        tcx = pv[..., 0] * t[..., 0] * pw + pcx
+        tcy = pv[..., 1] * t[..., 1] * ph + pcy
+        tw = jnp.exp(pv[..., 2] * t[..., 2]) * pw
+        th = jnp.exp(pv[..., 3] * t[..., 3]) * ph
+        out = jnp.stack([tcx - tw / 2, tcy - th / 2,
+                         tcx + tw / 2, tcy + th / 2], axis=-1)
+    return {"OutputBox": [out]}
+
+
+# --- bipartite_match ----------------------------------------------------------
+
+def _bipartite_infer(op_, block):
+    dv = in_var(op_, block, "DistMat")
+    if dv is not None and dv.shape is not None:
+        if len(dv.shape) == 3:
+            shape = [dv.shape[0], dv.shape[2]]
+        else:
+            shape = [1, dv.shape[1]]
+        set_out(op_, block, "ColToRowMatchIndices", shape, "int32")
+        set_out(op_, block, "ColToRowMatchDist", shape, "float32")
+
+
+def _bipartite_one(dist, row_len, match_type, overlap_threshold):
+    """Greedy global-argmax bipartite matching for one image (reference
+    bipartite_match_op.cc BipartiteMatch): repeatedly pick the largest
+    remaining (row, col) entry, retire both. Sequential by nature — a
+    bounded fori_loop with masked argmax, G iterations of O(G*P) work."""
+    g, p = dist.shape
+    row_valid = jnp.arange(g) < row_len
+    dist = jnp.where(row_valid[:, None], dist, -1.0)
+
+    def body(_, carry):
+        match_idx, match_dist, row_used = carry
+        masked = jnp.where(row_used[:, None] | (match_idx[None, :] >= 0)
+                           | (dist < _EPS), -1.0, dist)
+        flat = jnp.argmax(masked)
+        r, c = flat // p, flat % p
+        ok = masked[r, c] > 0.0
+        match_idx = jnp.where(ok, match_idx.at[c].set(r.astype(jnp.int32)),
+                              match_idx)
+        match_dist = jnp.where(ok, match_dist.at[c].set(dist[r, c]),
+                               match_dist)
+        row_used = jnp.where(ok, row_used.at[r].set(True), row_used)
+        return match_idx, match_dist, row_used
+
+    init = (jnp.full((p,), -1, jnp.int32), jnp.zeros((p,), dist.dtype),
+            jnp.zeros((g,), bool))
+    match_idx, match_dist, _ = jax.lax.fori_loop(0, g, body, init)
+
+    if match_type == "per_prediction":
+        # additionally match any unmatched column to its argmax row when the
+        # overlap clears the threshold (reference ArgMaxMatch)
+        best = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        bestd = jnp.max(dist, axis=0)
+        extra = (match_idx == -1) & (bestd >= overlap_threshold)
+        match_idx = jnp.where(extra, best, match_idx)
+        match_dist = jnp.where(extra, bestd, match_dist)
+    return match_idx, match_dist
+
+
+@op("bipartite_match", infer_shape=_bipartite_infer, grad=NO_GRAD)
+def _bipartite_match(ctx, op_, ins):
+    dist = jnp.asarray(ins["DistMat"][0])
+    if dist.ndim == 2:
+        dist = dist[None]
+    b, g, p = dist.shape
+    lens = _lengths(ctx, op_, "DistMat", b, g)
+    mt = op_.attr("match_type", "bipartite")
+    thr = op_.attr("dist_threshold", 0.5)
+    idx, d = jax.vmap(_bipartite_one, in_axes=(0, 0, None, None))(
+        dist, lens, mt, thr)
+    for slot in ("ColToRowMatchIndices", "ColToRowMatchDist"):
+        for n in op_.desc.outputs.get(slot, []):
+            ctx.set_seq_len(n, None)
+    return {"ColToRowMatchIndices": [idx], "ColToRowMatchDist": [d]}
+
+
+# --- mine_hard_examples -------------------------------------------------------
+
+@op("mine_hard_examples", grad=NO_GRAD)
+def _mine_hard_examples(ctx, op_, ins):
+    """Hard-negative mining (reference mine_hard_examples_op.cc). For
+    max_negative: eligible negatives (unmatched, low overlap) are ranked by
+    classification loss and the top num_pos*neg_pos_ratio kept. Selection
+    is a rank test on the sorted losses instead of the reference's
+    sort+set walk."""
+    cls_loss = jnp.asarray(ins["ClsLoss"][0])
+    match_idx = jnp.asarray(ins["MatchIndices"][0]).astype(jnp.int32)
+    match_dist = jnp.asarray(ins["MatchDist"][0])
+    if cls_loss.ndim == 3:
+        cls_loss = cls_loss[..., 0]
+    b, p = match_idx.shape
+    mining_type = op_.attr("mining_type", "max_negative")
+    neg_pos_ratio = op_.attr("neg_pos_ratio", 1.0)
+    neg_dist_threshold = op_.attr("neg_dist_threshold", 0.5)
+    sample_size = op_.attr("sample_size", 0)
+
+    loss = cls_loss
+    if mining_type == "hard_example" and ins.get("LocLoss") and \
+            ins["LocLoss"][0] is not None:
+        ll = jnp.asarray(ins["LocLoss"][0])
+        loss = loss + (ll[..., 0] if ll.ndim == 3 else ll)
+
+    if mining_type == "max_negative":
+        eligible = (match_idx == -1) & (match_dist < neg_dist_threshold)
+        num_pos = jnp.sum(match_idx != -1, axis=1)
+        neg_sel = jnp.minimum(
+            (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32),
+            eligible.sum(axis=1).astype(jnp.int32))
+    else:
+        eligible = jnp.ones_like(match_idx, dtype=bool)
+        neg_sel = jnp.minimum(jnp.full((b,), sample_size, jnp.int32),
+                              eligible.sum(axis=1).astype(jnp.int32))
+
+    masked = jnp.where(eligible, loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1, stable=True)
+    rank = jax.vmap(lambda o: jnp.zeros((p,), jnp.int32).at[o].set(
+        jnp.arange(p, dtype=jnp.int32)))(order)
+    selected = eligible & (rank < neg_sel[:, None])
+
+    # compact selected prior indices to the front, ascending (reference
+    # returns a LoD'd index list per image)
+    key = jnp.where(selected, jnp.arange(p)[None, :], p + 1)
+    sorted_idx = jnp.sort(key, axis=1)
+    neg_count = selected.sum(axis=1).astype(jnp.int32)
+    neg_indices = jnp.where(
+        jnp.arange(p)[None, :] < neg_count[:, None], sorted_idx, 0
+    ).astype(jnp.int32)
+
+    updated = match_idx
+    if mining_type == "hard_example":
+        updated = jnp.where((match_idx > -1) & ~selected, -1, match_idx)
+
+    out_name = op_.desc.outputs["NegIndices"][0]
+    ctx.set_seq_len(out_name, neg_count)
+    for n in op_.desc.outputs.get("UpdatedMatchIndices", []):
+        ctx.set_seq_len(n, None)
+    return {"NegIndices": [neg_indices[..., None]],
+            "UpdatedMatchIndices": [updated]}
+
+
+# --- target_assign ------------------------------------------------------------
+
+@op("target_assign", grad=NO_GRAD,
+    non_diff_inputs=("X", "MatchIndices", "NegIndices"))
+def _target_assign(ctx, op_, ins):
+    """Gather per-prior targets from per-image gt rows by match index
+    (reference target_assign_op.h): out[b, m] = X[b, match[b, m]] where
+    matched, else mismatch_value with weight 0; negative indices (from hard
+    mining) force weight 1 at mismatch_value."""
+    x = jnp.asarray(ins["X"][0])             # [B, G, K] or [B, G, M, K]
+    match = jnp.asarray(ins["MatchIndices"][0]).astype(jnp.int32)  # [B, M]
+    mismatch = op_.attr("mismatch_value", 0)
+    b, m = match.shape
+    k = x.shape[-1]
+    safe = jnp.clip(match, 0, x.shape[1] - 1)
+    if x.ndim == 4:
+        # per-prior targets (the reference's P axis, target_assign_op.h
+        # w_off = w % P): out[b, m] = X[b, match[b, m], m] — one fused
+        # gather, no [M, M] intermediate
+        gathered = x[jnp.arange(b)[:, None], safe, jnp.arange(m)[None, :], :]
+    else:
+        gathered = jnp.take_along_axis(x, safe[..., None], axis=1)
+    matched = (match > -1)[..., None]
+    out = jnp.where(matched, gathered,
+                    jnp.full_like(gathered, float(mismatch)))
+    wt = matched[..., 0].astype(jnp.float32)[..., None]
+
+    if ins.get("NegIndices") and ins["NegIndices"][0] is not None:
+        neg = jnp.asarray(ins["NegIndices"][0])
+        if neg.ndim == 3:
+            neg = neg[..., 0]
+        names = op_.desc.inputs.get("NegIndices", [])
+        ncount = ctx.seq_len(names[0]) if names else None
+        if ncount is None:
+            ncount = jnp.full((b,), neg.shape[1], jnp.int32)
+        valid = jnp.arange(neg.shape[1])[None, :] < \
+            jnp.asarray(ncount)[:, None]
+        onehot = jax.nn.one_hot(
+            jnp.where(valid, neg, m), m, dtype=jnp.float32)  # [B, N, M]
+        is_neg = onehot.sum(axis=1) > 0
+        wt = jnp.where(is_neg[..., None], 1.0, wt)
+    for slot in ("Out", "OutWeight"):
+        for n in op_.desc.outputs.get(slot, []):
+            ctx.set_seq_len(n, None)
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+# --- multiclass_nms -----------------------------------------------------------
+
+def _nms_class(boxes, scores, score_threshold, nms_threshold, top_k):
+    """Greedy NMS for one class (reference NMSFast): walk candidates in
+    score order, keep a box iff it overlaps no already-kept box. The
+    data-dependent erase loop becomes a fori_loop over the sorted list with
+    a keep mask — O(P^2) IoU is precomputed once and tiles cleanly."""
+    p = scores.shape[0]
+    order = jnp.argsort(-scores, stable=True)
+    sboxes = boxes[order]
+    sscores = scores[order]
+    valid = sscores > score_threshold
+    if top_k > -1:
+        valid = valid & (jnp.arange(p) < top_k)
+    iou = pairwise_iou(sboxes, sboxes)
+
+    def body(i, keep):
+        over = (iou[:, i] > nms_threshold) & keep & (jnp.arange(p) < i)
+        ki = valid[i] & ~jnp.any(over)
+        return keep.at[i].set(ki)
+
+    keep = jax.lax.fori_loop(0, p, body, jnp.zeros((p,), bool))
+    return order, keep
+
+
+def _nms_infer(op_, block):
+    bv = in_var(op_, block, "BBoxes")
+    sv = in_var(op_, block, "Scores")
+    if bv is None or bv.shape is None or sv is None or sv.shape is None:
+        return
+    keep_top_k = op_.attr("keep_top_k", -1)
+    cap = keep_top_k if keep_top_k > 0 else bv.shape[-2]
+    batch = sv.shape[0] if len(sv.shape) == 3 else 1
+    set_out(op_, block, "Out", [batch, cap, 6], bv.dtype)
+
+
+@op("multiclass_nms", infer_shape=_nms_infer, grad=NO_GRAD)
+def _multiclass_nms(ctx, op_, ins):
+    """Multi-class NMS (reference multiclass_nms_op.cc). Scores [B, C, P],
+    BBoxes [B, P, 4] (shared across classes) or [P, 4]. Output is padded
+    [B, cap, 6] rows (label, score, x1, y1, x2, y2) + @SEQLEN per-image
+    detection counts — the dense stand-in for the reference's LoD output."""
+    scores = jnp.asarray(ins["Scores"][0])
+    boxes = jnp.asarray(ins["BBoxes"][0])
+    if scores.ndim == 2:
+        scores = scores[None]
+    if boxes.ndim == 2:
+        boxes = boxes[None]
+    b, c, p = scores.shape
+    bg = op_.attr("background_label", 0)
+    score_threshold = op_.attr("score_threshold", 0.0)
+    nms_top_k = op_.attr("nms_top_k", -1)
+    keep_top_k = op_.attr("keep_top_k", -1)
+    nms_threshold = op_.attr("nms_threshold", 0.3)
+    cap = keep_top_k if keep_top_k > 0 else p
+
+    def one_image(sc, bx):
+        # per-class NMS -> (C, P) keep grid in original index space
+        def per_class(cs):
+            order, keep = _nms_class(bx, cs, score_threshold, nms_threshold,
+                                     nms_top_k)
+            # scatter keep back to original indices
+            return jnp.zeros((p,), bool).at[order].set(keep)
+
+        keeps = jax.vmap(per_class)(sc)          # (C, P)
+        if 0 <= bg < c:
+            keeps = keeps.at[bg].set(False)
+        flat_scores = jnp.where(keeps, sc, -jnp.inf).reshape(-1)
+        total = keeps.sum()
+        k = jnp.minimum(total, cap)
+        order = jnp.argsort(-flat_scores, stable=True)[:cap]
+        sel_class = (order // p).astype(jnp.float32)
+        sel_idx = order % p
+        sel_score = flat_scores.reshape(-1)[order]
+        sel_box = bx[sel_idx]
+        rows = jnp.concatenate(
+            [sel_class[:, None], sel_score[:, None], sel_box], axis=1)
+        rank_ok = jnp.arange(cap) < k
+        rows = jnp.where(rank_ok[:, None], rows, jnp.zeros_like(rows))
+        return rows, k.astype(jnp.int32)
+
+    rows, counts = jax.vmap(one_image)(scores, boxes)
+    out_name = op_.desc.outputs["Out"][0]
+    ctx.set_seq_len(out_name, counts)
+    return {"Out": [rows]}
+
+
+# --- detection_map ------------------------------------------------------------
+
+def _np_iou(a, b):
+    ixmin = max(a[0], b[0]); iymin = max(a[1], b[1])
+    ixmax = min(a[2], b[2]); iymax = min(a[3], b[3])
+    if b[0] > a[2] or b[2] < a[0] or b[1] > a[3] or b[3] < a[1]:
+        return 0.0
+    inter = (ixmax - ixmin) * (iymax - iymin)
+    a1 = (a[2] - a[0]) * (a[3] - a[1])
+    a2 = (b[2] - b[0]) * (b[3] - b[1])
+    return inter / max(a1 + a2 - inter, _EPS)
+
+
+def detection_map_np(dets, det_counts, gts, gt_counts, overlap_threshold,
+                     evaluate_difficult, ap_type, background_label):
+    """Host mAP (faithful port of reference detection_map_op.h
+    CalcTrueAndFalsePositive + CalcMAP). dets [B, D, 6] rows
+    (label, score, box); gts [B, G, 6] rows (label, difficult, box)."""
+    label_pos = {}
+    tp, fp = {}, {}
+    bsz = dets.shape[0]
+    for n in range(bsz):
+        g = gts[n][:int(gt_counts[n])]
+        for row in g:
+            lab = int(row[0])
+            diff = bool(abs(row[1]) > 1e-6)
+            if evaluate_difficult or not diff:
+                label_pos[lab] = label_pos.get(lab, 0) + 1
+    for n in range(bsz):
+        g = gts[n][:int(gt_counts[n])]
+        d = dets[n][:int(det_counts[n])]
+        gt_by_label = {}
+        for row in g:
+            gt_by_label.setdefault(int(row[0]), []).append(row)
+        det_by_label = {}
+        for row in d:
+            det_by_label.setdefault(int(row[0]), []).append(row)
+        for lab, rows in det_by_label.items():
+            if lab not in gt_by_label:
+                for row in rows:
+                    tp.setdefault(lab, []).append((float(row[1]), 0))
+                    fp.setdefault(lab, []).append((float(row[1]), 1))
+                continue
+            matched = gt_by_label[lab]
+            visited = [False] * len(matched)
+            rows = sorted(rows, key=lambda r: -r[1])
+            for row in rows:
+                box = np.clip(row[2:6], 0.0, 1.0)
+                score = float(row[1])
+                overlaps = [_np_iou(box, m[2:6]) for m in matched]
+                j = int(np.argmax(overlaps)) if overlaps else 0
+                if overlaps and overlaps[j] > overlap_threshold:
+                    mdiff = bool(abs(matched[j][1]) > 1e-6)
+                    if evaluate_difficult or not mdiff:
+                        if not visited[j]:
+                            tp.setdefault(lab, []).append((score, 1))
+                            fp.setdefault(lab, []).append((score, 0))
+                            visited[j] = True
+                        else:
+                            tp.setdefault(lab, []).append((score, 0))
+                            fp.setdefault(lab, []).append((score, 1))
+                else:
+                    tp.setdefault(lab, []).append((score, 0))
+                    fp.setdefault(lab, []).append((score, 1))
+    mAP, count = 0.0, 0
+    for lab, num_pos in label_pos.items():
+        if lab == background_label or lab not in tp or num_pos == 0:
+            continue
+        pairs_t = sorted(tp[lab], key=lambda x: -x[0])
+        pairs_f = sorted(fp[lab], key=lambda x: -x[0])
+        tps = np.cumsum([x[1] for x in pairs_t])
+        fps = np.cumsum([x[1] for x in pairs_f])
+        prec = tps / np.maximum(tps + fps, 1)
+        rec = tps / num_pos
+        if ap_type == "11point":
+            maxp = np.zeros(11)
+            for j in range(11):
+                mask = rec >= j / 10.0
+                maxp[j] = prec[mask].max() if mask.any() else 0.0
+            ap = maxp.sum() / 11.0
+        else:
+            ap, prev = 0.0, 0.0
+            for pr, rc in zip(prec, rec):
+                if abs(rc - prev) > 1e-6:
+                    ap += pr * abs(rc - prev)
+                prev = rc
+        mAP += ap
+        count += 1
+    return np.float32(mAP / count if count else 0.0)
+
+
+def _dmap_infer(op_, block):
+    set_out(op_, block, "MAP", [1], "float32")
+
+
+@op("detection_map", infer_shape=_dmap_infer, grad=NO_GRAD)
+def _detection_map(ctx, op_, ins):
+    """mAP metric (reference detection_map_op.h — a CPU-only kernel there
+    too). Runs as a host callback: per-class AP accumulation is inherently
+    sequential and once-per-batch, not MXU work. DetectRes/Label are padded
+    [B, D, 6]/[B, G, 6] + @SEQLEN."""
+    det = jnp.asarray(ins["DetectRes"][0])
+    gt = jnp.asarray(ins["Label"][0])
+    if det.ndim == 2:
+        det = det[None]
+    if gt.ndim == 2:
+        gt = gt[None]
+    dcount = _lengths(ctx, op_, "DetectRes", det.shape[0], det.shape[1])
+    gcount = _lengths(ctx, op_, "Label", gt.shape[0], gt.shape[1])
+    thr = op_.attr("overlap_threshold", 0.3)
+    ed = op_.attr("evaluate_difficult", True)
+    ap_type = op_.attr("ap_type", "integral")
+    bg = op_.attr("background_label", 0)
+
+    def cb(d, dc, g, gc):
+        return detection_map_np(np.asarray(d), np.asarray(dc), np.asarray(g),
+                                np.asarray(gc), thr, ed, ap_type, bg
+                                ).reshape(1)
+
+    out = jax.pure_callback(cb, jax.ShapeDtypeStruct((1,), np.float32),
+                            det, dcount, gt, gcount)
+    for n in op_.desc.outputs.get("MAP", []):
+        ctx.set_seq_len(n, None)
+    return {"MAP": [out]}

@@ -1,0 +1,254 @@
+"""Evaluation metric ops: chunk_eval, precision_recall, positive_negative_pair.
+
+TPU-native lowerings of the reference CPU-only metric kernels (reference:
+chunk_eval_op.h — sequential Segment extraction; precision_recall_op.h —
+per-sample TP/FP/TN/FN loop; positive_negative_pair_op.h — per-query pair
+loops over an unordered_map). All three are re-expressed as dense
+vectorized computations (boundary flags + row-wise cummax for chunking,
+one-hot scatter sums for the confusion states, an O(N^2) masked pairwise
+grid for ranking pairs) so they run inside the same jitted XLA computation
+as the model instead of forcing a host round-trip per batch."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import in_var, set_out
+from .registry import NO_GRAD, op
+
+# per-scheme tag ids, -1 = tag absent (reference chunk_eval_op.h:108-139)
+_SCHEMES = {
+    "IOB": dict(num_tags=2, begin=0, inside=1, end=-1, single=-1),
+    "IOE": dict(num_tags=2, begin=-1, inside=0, end=1, single=-1),
+    "IOBES": dict(num_tags=4, begin=0, inside=1, end=2, single=3),
+    "plain": dict(num_tags=1, begin=-1, inside=-1, end=-1, single=-1),
+}
+
+
+def _chunk_flags(labels, valid, num_chunk_types, sc):
+    """Per-position chunk begin/end flags + chunk type for padded [B, T]
+    label rows. Vectorized form of the reference's GetSegments state machine
+    (chunk_eval_op.h:38-77): a position is inside a chunk iff its type is
+    not 'other', so begins/ends reduce to adjacent-pair predicates."""
+    nt = sc["num_tags"]
+    other = num_chunk_types
+    tag = labels % nt
+    typ = labels // nt
+    typ = jnp.where(valid, typ, other)   # padding acts like 'O'
+
+    prev_tag = jnp.concatenate(
+        [jnp.full_like(tag[:, :1], -1), tag[:, :-1]], axis=1)
+    prev_typ = jnp.concatenate(
+        [jnp.full_like(typ[:, :1], other), typ[:, :-1]], axis=1)
+    next_tag = jnp.concatenate(
+        [tag[:, 1:], jnp.full_like(tag[:, :1], -1)], axis=1)
+    next_typ = jnp.concatenate(
+        [typ[:, 1:], jnp.full_like(typ[:, :1], other)], axis=1)
+
+    nonother = typ != other
+
+    def same_type_begin(ptag, ctag):
+        # ChunkBegin for prev_type == type, both non-other
+        return ((ctag == sc["begin"]) & (sc["begin"] >= 0)) | \
+               ((ctag == sc["single"]) & (sc["single"] >= 0)) | \
+               (((ctag == sc["inside"]) | (ctag == sc["end"])) &
+                ((ptag == sc["end"]) | (ptag == sc["single"])) &
+                (sc["end"] >= 0))
+
+    def same_type_end(ptag, ctag):
+        # ChunkEnd for prev_type == type, both non-other
+        return (((ptag == sc["begin"]) | (ptag == sc["inside"])) &
+                (((ctag == sc["begin"]) & (sc["begin"] >= 0)) |
+                 ((ctag == sc["single"]) & (sc["single"] >= 0)))) | \
+               (((ptag == sc["end"]) | (ptag == sc["single"])) &
+                (sc["end"] >= 0))
+
+    begin = nonother & ((prev_typ == other) | (prev_typ != typ) |
+                        same_type_begin(prev_tag, tag))
+    end = nonother & ((next_typ == other) | (next_typ != typ) |
+                      same_type_end(tag, next_tag))
+    return begin, end, typ
+
+
+def _chunk_start_idx(begin):
+    """start index of the chunk covering each position: running max of the
+    positions where a chunk begins."""
+    t = begin.shape[1]
+    pos = jnp.arange(t)[None, :]
+    marked = jnp.where(begin, pos, -1)
+    return jax.lax.associative_scan(jnp.maximum, marked, axis=1)
+
+
+def _chunk_eval_infer(op_, block):
+    for slot in ("Precision", "Recall", "F1-Score"):
+        set_out(op_, block, slot, [1], "float32")
+    for slot in ("NumInferChunks", "NumLabelChunks", "NumCorrectChunks"):
+        set_out(op_, block, slot, [1], "int32")
+
+
+@op("chunk_eval", infer_shape=_chunk_eval_infer, grad=NO_GRAD)
+def _chunk_eval(ctx, op_, ins):
+    """Chunking (NER-style) precision/recall/F1 (reference chunk_eval_op.h).
+    Inference and Label are padded [B, T] int rows + @SEQLEN. A correct
+    chunk is an exactly matching (begin, end, type) span in both sequences;
+    excluded_chunk_types drop from the correct count only, as in the
+    reference (EvalOneSeq)."""
+    inf = jnp.asarray(ins["Inference"][0])
+    lab = jnp.asarray(ins["Label"][0])
+    if inf.ndim == 3:
+        inf = inf[..., 0]
+    if lab.ndim == 3:
+        lab = lab[..., 0]
+    b, t = inf.shape
+    names = op_.desc.inputs.get("Label", [])
+    lens = ctx.seq_len(names[0]) if names else None
+    if lens is None:
+        valid = jnp.ones((b, t), dtype=bool)
+    else:
+        valid = jnp.arange(t)[None, :] < jnp.asarray(lens)[:, None]
+
+    nct = op_.attr("num_chunk_types")
+    sc = _SCHEMES[op_.attr("chunk_scheme", "IOB")]
+    excluded = op_.attr("excluded_chunk_types", []) or []
+
+    ib, ie, ityp = _chunk_flags(inf.astype(jnp.int32), valid, nct, sc)
+    lb, le, ltyp = _chunk_flags(lab.astype(jnp.int32), valid, nct, sc)
+    istart = _chunk_start_idx(ib)
+    lstart = _chunk_start_idx(lb)
+
+    correct = ie & le & (istart == lstart) & (ityp == ltyp)
+    for ex in excluded:
+        correct = correct & (ityp != ex)
+
+    n_inf = ib.sum().astype(jnp.int32)
+    n_lab = lb.sum().astype(jnp.int32)
+    n_cor = correct.sum().astype(jnp.int32)
+    prec = jnp.where(n_inf > 0, n_cor / jnp.maximum(n_inf, 1), 0.0)
+    rec = jnp.where(n_lab > 0, n_cor / jnp.maximum(n_lab, 1), 0.0)
+    f1 = jnp.where(n_cor > 0,
+                   2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
+    for slot in op_.desc.outputs:
+        for name in op_.desc.outputs[slot]:
+            ctx.set_seq_len(name, None)
+    return {"Precision": [prec.astype(jnp.float32)[None]],
+            "Recall": [rec.astype(jnp.float32)[None]],
+            "F1-Score": [f1.astype(jnp.float32)[None]],
+            "NumInferChunks": [n_inf[None]],
+            "NumLabelChunks": [n_lab[None]],
+            "NumCorrectChunks": [n_cor[None]]}
+
+
+def _pr_infer(op_, block):
+    c = op_.attr("class_number")
+    set_out(op_, block, "BatchMetrics", [6], "float32")
+    set_out(op_, block, "AccumMetrics", [6], "float32")
+    set_out(op_, block, "AccumStatesInfo", [c, 4], "float32")
+
+
+def _pr_metrics(states, cls_num):
+    """states [C, 4] = per-class TP/FP/TN/FN -> the 6 macro/micro metrics
+    (reference precision_recall_op.h ComputeMetrics)."""
+    tp, fp, fn = states[:, 0], states[:, 1], states[:, 3]
+
+    def prec(tp_, fp_):
+        return jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12),
+                         1.0)
+
+    def rec(tp_, fn_):
+        return jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12),
+                         1.0)
+
+    def f1(p, r):
+        return jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12),
+                         0.0)
+
+    macro_p = prec(tp, fp).mean()
+    macro_r = rec(tp, fn).mean()
+    micro_p = prec(tp.sum(), fp.sum())
+    micro_r = rec(tp.sum(), fn.sum())
+    return jnp.stack([macro_p, macro_r, f1(macro_p, macro_r),
+                      micro_p, micro_r, f1(micro_p, micro_r)])
+
+
+@op("precision_recall", infer_shape=_pr_infer, grad=NO_GRAD)
+def _precision_recall(ctx, op_, ins):
+    """Multi-class precision/recall/F1 with accumulation (reference
+    precision_recall_op.h). Indices/Labels [N, 1] int; optional Weights
+    [N, 1]; optional StatesInfo [C, 4] carries TP/FP/TN/FN across batches."""
+    idx = jnp.asarray(ins["Indices"][0]).reshape(-1).astype(jnp.int32)
+    lab = jnp.asarray(ins["Labels"][0]).reshape(-1).astype(jnp.int32)
+    cls_num = op_.attr("class_number")
+    n = idx.shape[0]
+    if ins.get("Weights") and ins["Weights"][0] is not None:
+        w = jnp.asarray(ins["Weights"][0]).reshape(-1).astype(jnp.float32)
+    else:
+        w = jnp.ones((n,), jnp.float32)
+
+    oh_idx = jax.nn.one_hot(idx, cls_num, dtype=jnp.float32)
+    oh_lab = jax.nn.one_hot(lab, cls_num, dtype=jnp.float32)
+    hit = (idx == lab).astype(jnp.float32)
+    tp = (oh_idx * hit[:, None] * w[:, None]).sum(0)
+    fp = (oh_idx * (1 - hit)[:, None] * w[:, None]).sum(0)
+    fn = (oh_lab * (1 - hit)[:, None] * w[:, None]).sum(0)
+    # TN: every sample adds w to all classes except its idx (and its label
+    # when mispredicted) — reference lines 66-81
+    tn = w.sum() - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+
+    accum_states = batch_states
+    if ins.get("StatesInfo") and ins["StatesInfo"][0] is not None:
+        accum_states = accum_states + \
+            jnp.asarray(ins["StatesInfo"][0]).astype(jnp.float32)
+    return {"BatchMetrics": [_pr_metrics(batch_states, cls_num)],
+            "AccumMetrics": [_pr_metrics(accum_states, cls_num)],
+            "AccumStatesInfo": [accum_states]}
+
+
+def _pnp_infer(op_, block):
+    for slot in ("PositivePair", "NegativePair", "NeutralPair"):
+        set_out(op_, block, slot, [1], "float32")
+
+
+@op("positive_negative_pair", infer_shape=_pnp_infer, grad=NO_GRAD)
+def _positive_negative_pair(ctx, op_, ins):
+    """Ranking pair statistics per query (reference
+    positive_negative_pair_op.h): for each same-query pair with different
+    labels, count the pair as positive if score order matches label order,
+    negative otherwise, neutral on score ties; weight = mean pair weight."""
+    score = jnp.asarray(ins["Score"][0])
+    label = jnp.asarray(ins["Label"][0]).reshape(-1)
+    query = jnp.asarray(ins["QueryID"][0]).reshape(-1)
+    col = op_.attr("column", -1)
+    s = score.reshape(score.shape[0], -1)[:, col]
+    n = s.shape[0]
+    if ins.get("Weight") and ins["Weight"][0] is not None:
+        w = jnp.asarray(ins["Weight"][0]).reshape(-1).astype(jnp.float32)
+    else:
+        w = jnp.ones((n,), jnp.float32)
+
+    iu = jnp.triu(jnp.ones((n, n), bool), k=1)
+    same_q = query[:, None] == query[None, :]
+    diff_l = label[:, None] != label[None, :]
+    pair = iu & same_q & diff_l
+    pw = (w[:, None] + w[None, :]) * 0.5
+    ds = s[:, None] - s[None, :]
+    dl = (label[:, None] - label[None, :]).astype(s.dtype)
+    tie = ds == 0
+    pos = (pair & (ds * dl > 0)).astype(jnp.float32) * pw
+    neg = (pair & ~tie & (ds * dl <= 0)).astype(jnp.float32) * pw
+    # reference counts a tie as neutral AND as negative (the ternary falls
+    # through to neg when ds == 0) — preserved for parity
+    negt = (pair & tie).astype(jnp.float32) * pw
+    neu = negt
+    p = pos.sum()
+    ng = neg.sum() + negt.sum()
+    nu = neu.sum()
+    if ins.get("AccumulatePositivePair") and \
+            ins["AccumulatePositivePair"][0] is not None:
+        p = p + jnp.asarray(ins["AccumulatePositivePair"][0]).reshape(())
+        ng = ng + jnp.asarray(ins["AccumulateNegativePair"][0]).reshape(())
+        nu = nu + jnp.asarray(ins["AccumulateNeutralPair"][0]).reshape(())
+    return {"PositivePair": [p[None]], "NegativePair": [ng[None]],
+            "NeutralPair": [nu[None]]}

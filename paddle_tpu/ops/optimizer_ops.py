@@ -29,12 +29,19 @@ def _lr(ins):
     return jnp.asarray(ins["LearningRate"][0]).reshape(())
 
 
+def _param_grad(ins):
+    """(param, grad) with the grad upcast to the param dtype: fp32
+    master-weight updates under AMP O2 receive bf16 grads, which must be
+    upcast before any arithmetic so lr*g and accumulators stay full
+    precision."""
+    p = jnp.asarray(ins["Param"][0])
+    return p, jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+
+
+
 @op("sgd", grad=NO_GRAD, infer_shape=_param_out_infer(("Param", "ParamOut")))
 def _sgd(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     return {"ParamOut": [p - _lr(ins) * g]}
 
 
@@ -42,10 +49,7 @@ def _sgd(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"),
                                  ("Velocity", "VelocityOut")))
 def _momentum(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     v = jnp.asarray(ins["Velocity"][0])
     mu = op_.attr("mu")
     v_out = mu * v + g
@@ -60,10 +64,7 @@ def _momentum(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment1", "Moment1Out"),
                                  ("Moment2", "Moment2Out")))
 def _adam(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     m1 = jnp.asarray(ins["Moment1"][0])
     m2 = jnp.asarray(ins["Moment2"][0])
     b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
@@ -82,10 +83,7 @@ def _adam(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut"),
                                  ("InfNorm", "InfNormOut")))
 def _adamax(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     m = jnp.asarray(ins["Moment"][0])
     u = jnp.asarray(ins["InfNorm"][0])
     b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
@@ -101,10 +99,7 @@ def _adamax(ctx, op_, ins):
 @op("adagrad", grad=NO_GRAD,
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
 def _adagrad(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     m = jnp.asarray(ins["Moment"][0])
     eps = op_.attr("epsilon", 1e-6)
     mo = m + g * g
@@ -115,10 +110,7 @@ def _adagrad(ctx, op_, ins):
 @op("decayed_adagrad", grad=NO_GRAD,
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
 def _decayed_adagrad(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     m = jnp.asarray(ins["Moment"][0])
     decay = op_.attr("decay", 0.95)
     eps = op_.attr("epsilon", 1e-6)
@@ -132,10 +124,7 @@ def _decayed_adagrad(ctx, op_, ins):
                                  ("AvgSquaredGrad", "AvgSquaredGradOut"),
                                  ("AvgSquaredUpdate", "AvgSquaredUpdateOut")))
 def _adadelta(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     ag = jnp.asarray(ins["AvgSquaredGrad"][0])
     au = jnp.asarray(ins["AvgSquaredUpdate"][0])
     rho = op_.attr("rho", 0.95)
@@ -151,10 +140,7 @@ def _adadelta(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut"),
                                  ("MeanSquare", "MeanSquareOut")))
 def _rmsprop(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     mom = jnp.asarray(ins["Moment"][0])
     ms = jnp.asarray(ins["MeanSquare"][0])
     rho = op_.attr("decay", 0.9)
@@ -170,10 +156,7 @@ def _rmsprop(ctx, op_, ins):
                                  ("SquaredAccumulator", "SquaredAccumOut"),
                                  ("LinearAccumulator", "LinearAccumOut")))
 def _ftrl(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     sq = jnp.asarray(ins["SquaredAccumulator"][0])
     lin = jnp.asarray(ins["LinearAccumulator"][0])
     l1 = op_.attr("l1", 0.0)
@@ -199,10 +182,7 @@ def _ftrl(ctx, op_, ins):
 @op("proximal_gd", grad=NO_GRAD,
     infer_shape=_param_out_infer(("Param", "ParamOut")))
 def _proximal_gd(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     l1 = op_.attr("l1", 0.0)
     l2 = op_.attr("l2", 0.0)
     lr = _lr(ins)
@@ -215,10 +195,7 @@ def _proximal_gd(ctx, op_, ins):
 @op("proximal_adagrad", grad=NO_GRAD,
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
 def _proximal_adagrad(ctx, op_, ins):
-    p = jnp.asarray(ins["Param"][0])
-    # fp32 master-weight update: bf16 grads (AMP O2) upcast before
-    # any arithmetic so lr*g and accumulators stay full precision
-    g = jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    p, g = _param_grad(ins)
     m = jnp.asarray(ins["Moment"][0])
     l1 = op_.attr("l1", 0.0)
     l2 = op_.attr("l2", 0.0)

@@ -1,0 +1,455 @@
+"""Vision op family: 3D pooling/conv-transpose, index pooling, unpool, SPP,
+ROI pooling, crop, prelu, conv_shift.
+
+TPU-native lowerings of the reference CUDA/CPU kernels (reference:
+pool_op.cc [pool3d], pool_with_index_op.cc, unpool_op.cc, spp_op.cc,
+roi_pool_op.cc, crop_op.cc, conv_transpose_op.cc [conv3d_transpose],
+prelu_op.cc, conv_shift_op.cc). Everything is expressed as dense XLA ops —
+windows become `lax.reduce_window` / stacked static slices, ROI bins become
+broadcast masks (no data-dependent slicing, so XLA can tile for the MXU/VPU)
+— and gradients come from the generic vjp kernel, which routes max-pool
+cotangents through the argmax gather exactly like the reference's
+hand-written backward kernels do with their saved masks.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import NO_GRAD, op
+from .common import in_var, mxu_cast, out_var, same_as_input, set_out
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return list(v) if len(v) == 3 else list(v) * 3
+    return [v, v, v]
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v) if len(v) == 2 else list(v) * 2
+    return [v, v]
+
+
+# --- pool3d -----------------------------------------------------------------
+
+def _pool3d_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is None or xv.shape is None:
+        return
+    if op_.attr("global_pooling", False):
+        set_out(op_, block, "Out", list(xv.shape[:2]) + [1, 1, 1], xv.dtype)
+        return
+    k = _triple(op_.attr("ksize"))
+    s = _triple(op_.attr("strides", [1, 1, 1]))
+    p = _triple(op_.attr("paddings", [0, 0, 0]))
+
+    def odim(i, kk, pp, ss):
+        if i is None or i < 0:
+            return None
+        if op_.attr("ceil_mode", False):
+            return (i - kk + 2 * pp + ss - 1) // ss + 1
+        return (i - kk + 2 * pp) // ss + 1
+
+    n, c, d, h, w = xv.shape
+    set_out(op_, block, "Out",
+            [n, c, odim(d, k[0], p[0], s[0]), odim(h, k[1], p[1], s[1]),
+             odim(w, k[2], p[2], s[2])], xv.dtype)
+
+
+@op("pool3d", infer_shape=_pool3d_infer)
+def _pool3d(ctx, op_, ins):
+    """NCDHW max/avg pooling (reference pool_op.cc pool3d registration)."""
+    x = jnp.asarray(ins["X"][0])
+    if op_.attr("global_pooling", False):
+        k = list(x.shape[2:])
+        s, p = k, [0, 0, 0]
+    else:
+        k = _triple(op_.attr("ksize"))
+        s = _triple(op_.attr("strides", [1, 1, 1]))
+        p = _triple(op_.attr("paddings", [0, 0, 0]))
+    window = (1, 1, k[0], k[1], k[2])
+    strides = (1, 1, s[0], s[1], s[2])
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]))
+    if op_.attr("pooling_type", "max") == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                    strides, pads)
+    else:
+        out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+        if op_.attr("exclusive", True):
+            ones = jnp.ones(x.shape[2:], dtype=x.dtype)[None, None]
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, pads)
+            out = out / cnt
+        else:
+            out = out / (k[0] * k[1] * k[2])
+    return {"Out": [out]}
+
+
+# --- max pool with index ----------------------------------------------------
+
+def _windows2d(x, k, s, p, fill):
+    """(N,C,H,W) -> windows (N,C,OH,OW,kh*kw) plus flat input index of each
+    window element ((OH,OW,kh*kw), -1 where padding)."""
+    n, c, h, w = x.shape
+    oh = (h - k[0] + 2 * p[0]) // s[0] + 1
+    ow = (w - k[1] + 2 * p[1]) // s[1] + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                 constant_values=fill)
+    cols, idxs = [], []
+    hh = jnp.arange(oh) * s[0]
+    ww = jnp.arange(ow) * s[1]
+    for ki, kj in itertools.product(range(k[0]), range(k[1])):
+        cols.append(jax.lax.slice(
+            xp, (0, 0, ki, kj),
+            (n, c, ki + (oh - 1) * s[0] + 1, kj + (ow - 1) * s[1] + 1),
+            (1, 1, s[0], s[1])))
+        hi = hh[:, None] + ki - p[0]
+        wi = ww[None, :] + kj - p[1]
+        valid = (hi >= 0) & (hi < h) & (wi >= 0) & (wi < w)
+        idxs.append(jnp.where(valid, hi * w + wi, -1))
+    return jnp.stack(cols, axis=-1), jnp.stack(idxs, axis=-1)
+
+
+def _pool_index_infer():
+    def infer(op_, block):
+        xv = in_var(op_, block, "X")
+        if xv is None or xv.shape is None:
+            return
+        nd = len(xv.shape) - 2
+        if op_.attr("global_pooling", False):
+            oshape = list(xv.shape[:2]) + [1] * nd
+        else:
+            k = op_.attr("ksize")
+            s = op_.attr("strides", [1] * nd)
+            p = op_.attr("paddings", [0] * nd)
+            oshape = list(xv.shape[:2]) + [
+                None if d is None else (d - k[i] + 2 * p[i]) // s[i] + 1
+                for i, d in enumerate(xv.shape[2:])]
+        set_out(op_, block, "Out", oshape, xv.dtype)
+        set_out(op_, block, "Mask", oshape, "int32")
+    return infer
+
+
+@op("max_pool2d_with_index", infer_shape=_pool_index_infer())
+def _max_pool2d_with_index(ctx, op_, ins):
+    """Max pool that also emits the argmax position as a flat h*W+w index
+    into the input plane (reference pool_with_index_op.cc). The forward is a
+    gather at the argmax, so the generic vjp scatters the cotangent to the
+    max element — identical math to the reference's mask-driven backward."""
+    x = jnp.asarray(ins["X"][0])
+    if op_.attr("global_pooling", False):
+        k, s, p = list(x.shape[2:]), list(x.shape[2:]), [0, 0]
+    else:
+        k = _pair(op_.attr("ksize"))
+        s = _pair(op_.attr("strides", [1, 1]))
+        p = _pair(op_.attr("paddings", [0, 0]))
+    wins, idx = _windows2d(x, k, s, p, -jnp.inf)
+    am = jnp.argmax(wins, axis=-1)
+    out = jnp.take_along_axis(wins, am[..., None], axis=-1)[..., 0]
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(idx, wins.shape), am[..., None], axis=-1)[..., 0]
+    return {"Out": [out], "Mask": [mask.astype(jnp.int32)]}
+
+
+@op("max_pool3d_with_index", infer_shape=_pool_index_infer())
+def _max_pool3d_with_index(ctx, op_, ins):
+    x = jnp.asarray(ins["X"][0])
+    if op_.attr("global_pooling", False):
+        k, s, p = list(x.shape[2:]), list(x.shape[2:]), [0, 0, 0]
+    else:
+        k = _triple(op_.attr("ksize"))
+        s = _triple(op_.attr("strides", [1, 1, 1]))
+        p = _triple(op_.attr("paddings", [0, 0, 0]))
+    n, c, d, h, w = x.shape
+    od = (d - k[0] + 2 * p[0]) // s[0] + 1
+    oh = (h - k[1] + 2 * p[1]) // s[1] + 1
+    ow = (w - k[2] + 2 * p[2]) // s[2] + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]),
+                     (p[2], p[2])), constant_values=-jnp.inf)
+    cols, idxs = [], []
+    dd = jnp.arange(od) * s[0]
+    hh = jnp.arange(oh) * s[1]
+    ww = jnp.arange(ow) * s[2]
+    for kd, ki, kj in itertools.product(range(k[0]), range(k[1]), range(k[2])):
+        cols.append(jax.lax.slice(
+            xp, (0, 0, kd, ki, kj),
+            (n, c, kd + (od - 1) * s[0] + 1, ki + (oh - 1) * s[1] + 1,
+             kj + (ow - 1) * s[2] + 1),
+            (1, 1, s[0], s[1], s[2])))
+        di = dd[:, None, None] + kd - p[0]
+        hi = hh[None, :, None] + ki - p[1]
+        wi = ww[None, None, :] + kj - p[2]
+        valid = (di >= 0) & (di < d) & (hi >= 0) & (hi < h) & \
+            (wi >= 0) & (wi < w)
+        idxs.append(jnp.where(valid, (di * h + hi) * w + wi, -1))
+    wins = jnp.stack(cols, axis=-1)
+    idx = jnp.stack(idxs, axis=-1)
+    am = jnp.argmax(wins, axis=-1)
+    out = jnp.take_along_axis(wins, am[..., None], axis=-1)[..., 0]
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(idx, wins.shape), am[..., None], axis=-1)[..., 0]
+    return {"Out": [out], "Mask": [mask.astype(jnp.int32)]}
+
+
+# --- unpool -----------------------------------------------------------------
+
+def _unpool_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is None or xv.shape is None:
+        return
+    us = op_.attr("unpooled_size", None)
+    if us:
+        set_out(op_, block, "Out", list(xv.shape[:2]) + list(us), xv.dtype)
+
+
+@op("unpool", infer_shape=_unpool_infer, non_diff_inputs=("Indices",))
+def _unpool(ctx, op_, ins):
+    """Max-unpool: scatter pooled values back to the argmax positions stored
+    in Indices (reference unpool_op.cc; indices as produced by
+    max_pool2d_with_index)."""
+    x = jnp.asarray(ins["X"][0])
+    idx = jnp.asarray(ins["Indices"][0]).astype(jnp.int32)
+    oh, ow = op_.attr("unpooled_size")
+    n, c = x.shape[:2]
+    xf = x.reshape(n * c, -1)
+    idf = idx.reshape(n * c, -1)
+    out = jnp.zeros((n * c, oh * ow), dtype=x.dtype)
+    out = out.at[jnp.arange(n * c)[:, None], idf].set(xf)
+    return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+# --- spatial pyramid pooling ------------------------------------------------
+
+def _spp_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is None or xv.shape is None:
+        return
+    ph = op_.attr("pyramid_height")
+    bins = sum(4 ** i for i in range(ph))
+    n, c = xv.shape[:2]
+    set_out(op_, block, "Out",
+            [n, None if c is None else c * bins], xv.dtype)
+
+
+@op("spp", infer_shape=_spp_infer)
+def _spp(ctx, op_, ins):
+    """Spatial pyramid pooling (reference spp_op.cc): pool at 1x1, 2x2, 4x4…
+    grids and concatenate the flattened per-level outputs."""
+    x = jnp.asarray(ins["X"][0])
+    ptype = op_.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for level in range(op_.attr("pyramid_height")):
+        b = 2 ** level
+        kh, kw = math.ceil(h / b), math.ceil(w / b)
+        ph = (kh * b - h + 1) // 2
+        pw = (kw * b - w + 1) // 2
+        window = (1, 1, kh, kw)
+        strides = (1, 1, kh, kw)
+        pads = ((0, 0), (0, 0), (ph, kh * b - h - ph), (pw, kw * b - w - pw))
+        if ptype == "max":
+            o = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                      strides, pads)
+        else:
+            o = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides,
+                                      pads) / (kh * kw)
+        outs.append(o.reshape(n, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+# --- ROI pooling ------------------------------------------------------------
+
+def _roi_pool_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    rv = in_var(op_, block, "ROIs")
+    if xv is None or xv.shape is None or rv is None or rv.shape is None:
+        return
+    ph, pw = op_.attr("pooled_height"), op_.attr("pooled_width")
+    set_out(op_, block, "Out", [rv.shape[0], xv.shape[1], ph, pw], xv.dtype)
+    set_out(op_, block, "Argmax", [rv.shape[0], xv.shape[1], ph, pw], "int32")
+
+
+@op("roi_pool", infer_shape=_roi_pool_infer,
+    non_diff_inputs=("ROIs", "RoiBatchId"))
+def _roi_pool(ctx, op_, ins):
+    """ROI max pooling (reference roi_pool_op.cc). The reference quantizes
+    each ROI into pooled_h x pooled_w bins and max-pools each bin with a
+    data-dependent loop; here each bin is a broadcast membership mask over
+    the (static) feature plane — masked max — which XLA vectorizes, and the
+    vjp routes the cotangent to the argmax exactly like the reference's
+    saved-argmax backward. ROIs are [x1, y1, x2, y2] rows; the owning batch
+    index comes from the optional RoiBatchId input (LoD in the reference)."""
+    x = jnp.asarray(ins["X"][0])                 # (N,C,H,W)
+    rois = jnp.asarray(ins["ROIs"][0])           # (R,4)
+    scale = op_.attr("spatial_scale", 1.0)
+    ph, pw = op_.attr("pooled_height"), op_.attr("pooled_width")
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    if ins.get("RoiBatchId") and ins["RoiBatchId"][0] is not None:
+        bid = jnp.asarray(ins["RoiBatchId"][0]).reshape(-1).astype(jnp.int32)
+    else:
+        bid = jnp.zeros((r,), dtype=jnp.int32)
+
+    # integer bin boundaries, matching the reference's round-then-clip
+    x1 = jnp.round(rois[:, 0] * scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
+    x2 = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
+    y2 = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
+    rh = jnp.maximum(y2 - y1 + 1, 1)
+    rw = jnp.maximum(x2 - x1 + 1, 1)
+
+    pi = jnp.arange(ph)
+    pj = jnp.arange(pw)
+    # (R, ph): bin start/end rows, floor/ceil like the reference
+    hstart = y1[:, None] + (pi[None, :] * rh[:, None]) // ph
+    hend = y1[:, None] + ((pi[None, :] + 1) * rh[:, None] + ph - 1) // ph
+    wstart = x1[:, None] + (pj[None, :] * rw[:, None]) // pw
+    wend = x1[:, None] + ((pj[None, :] + 1) * rw[:, None] + pw - 1) // pw
+    hstart = jnp.clip(hstart, 0, h)
+    hend = jnp.clip(hend, 0, h)
+    wstart = jnp.clip(wstart, 0, w)
+    wend = jnp.clip(wend, 0, w)
+
+    rows = jnp.arange(h)
+    cols = jnp.arange(w)
+    # (R, ph, H) / (R, pw, W) membership
+    rmask = (rows[None, None, :] >= hstart[:, :, None]) & \
+            (rows[None, None, :] < hend[:, :, None])
+    cmask = (cols[None, None, :] >= wstart[:, :, None]) & \
+            (cols[None, None, :] < wend[:, :, None])
+    # (R, ph, pw, H, W)
+    mask = rmask[:, :, None, :, None] & cmask[:, None, :, None, :]
+    feat = x[bid]                                # (R,C,H,W)
+    masked = jnp.where(mask[:, None], feat[:, :, None, None],
+                       jnp.array(-jnp.inf, dtype=x.dtype))
+    flat = masked.reshape(r, c, ph, pw, h * w)
+    am = jnp.argmax(flat, axis=-1)
+    out = jnp.take_along_axis(flat, am[..., None], axis=-1)[..., 0]
+    empty = ~jnp.any(mask, axis=(-2, -1))        # (R,ph,pw)
+    out = jnp.where(empty[:, None], jnp.zeros_like(out), out)
+    return {"Out": [out], "Argmax": [am.astype(jnp.int32)]}
+
+
+# --- crop -------------------------------------------------------------------
+
+def _crop_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    shp = op_.attr("shape", None)
+    yv = in_var(op_, block, "Y")
+    if shp:
+        set_out(op_, block, "Out", list(shp),
+                xv.dtype if xv is not None else None)
+    elif yv is not None and yv.shape is not None and xv is not None:
+        set_out(op_, block, "Out", yv.shape, xv.dtype)
+
+
+@op("crop", infer_shape=_crop_infer, non_diff_inputs=("Y", "Offsets"))
+def _crop(ctx, op_, ins):
+    """Crop X to `shape` (attr, or Y's shape) at `offsets` (attr or input)
+    (reference crop_op.cc)."""
+    x = jnp.asarray(ins["X"][0])
+    if ins.get("Y") and ins["Y"][0] is not None:
+        shape = list(jnp.asarray(ins["Y"][0]).shape)
+    else:
+        shape = list(op_.attr("shape"))
+    if ins.get("Offsets") and ins["Offsets"][0] is not None:
+        off = jnp.asarray(ins["Offsets"][0]).astype(jnp.int32).reshape(-1)
+        out = jax.lax.dynamic_slice(x, [off[i] for i in range(x.ndim)], shape)
+    else:
+        off = op_.attr("offsets", [0] * x.ndim)
+        out = jax.lax.slice(x, off, [o + s for o, s in zip(off, shape)])
+    return {"Out": [out]}
+
+
+# --- conv3d_transpose -------------------------------------------------------
+
+def _convt3d_infer(op_, block):
+    xv = in_var(op_, block, "Input")
+    wv = in_var(op_, block, "Filter")
+    if xv is None or xv.shape is None or wv is None or wv.shape is None:
+        return
+    s = _triple(op_.attr("strides", [1, 1, 1]))
+    p = _triple(op_.attr("paddings", [0, 0, 0]))
+    d = _triple(op_.attr("dilations", [1, 1, 1]))
+    n = xv.shape[0]
+    cout = wv.shape[1]
+    dims = []
+    for i, sz in enumerate(xv.shape[2:]):
+        if sz is None or wv.shape[2 + i] is None:
+            dims.append(None)
+        else:
+            k = d[i] * (wv.shape[2 + i] - 1) + 1
+            dims.append(s[i] * (sz - 1) + k - 2 * p[i])
+    set_out(op_, block, "Output", [n, cout] + dims, xv.dtype)
+
+
+@op("conv3d_transpose", infer_shape=_convt3d_infer)
+def _conv3d_transpose(ctx, op_, ins):
+    """Transposed 3D conv as gradient-of-conv: dilate input by stride, pad by
+    k-1-p, convolve with the flipped filter (reference conv_transpose_op.cc
+    conv3d_transpose; filter layout IODHW)."""
+    x = jnp.asarray(ins["Input"][0])
+    w = jnp.asarray(ins["Filter"][0])   # (Cin, Cout, kd, kh, kw)
+    s = _triple(op_.attr("strides", [1, 1, 1]))
+    p = _triple(op_.attr("paddings", [0, 0, 0]))
+    d = _triple(op_.attr("dilations", [1, 1, 1]))
+    ks = [d[i] * (w.shape[2 + i] - 1) + 1 for i in range(3)]
+    (x, w), restore = mxu_cast(ctx, x, w)
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3, 4)).swapaxes(0, 1),
+        window_strides=(1, 1, 1),
+        padding=[(ks[i] - 1 - p[i], ks[i] - 1 - p[i]) for i in range(3)],
+        lhs_dilation=s, rhs_dilation=d,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    if restore is not None:
+        out = out.astype(restore)
+    return {"Output": [out]}
+
+
+# --- prelu ------------------------------------------------------------------
+
+@op("prelu", infer_shape=same_as_input())
+def _prelu(ctx, op_, ins):
+    """Parametric ReLU (reference prelu_op.cc): modes all (one alpha),
+    channel (per-C), element (per-element)."""
+    x = jnp.asarray(ins["X"][0])
+    alpha = jnp.asarray(ins["Alpha"][0])
+    mode = op_.attr("mode", "all")
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:
+        a = alpha.reshape(x.shape)
+    return {"Out": [jnp.where(x > 0, x, a * x)]}
+
+
+# --- conv_shift -------------------------------------------------------------
+
+def _conv_shift_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None:
+        set_out(op_, block, "Out", xv.shape, xv.dtype)
+
+
+@op("conv_shift", infer_shape=_conv_shift_infer)
+def _conv_shift(ctx, op_, ins):
+    """Circular convolution out[i] = sum_j x[(i + j - N/2) mod M] * y[j]
+    (reference conv_shift_op.cc; N odd, N <= M). Lowered as N static rolls —
+    N is small (attention shift kernels), so this stays fused elementwise
+    work instead of a gather."""
+    x = jnp.asarray(ins["X"][0])   # (B, M)
+    y = jnp.asarray(ins["Y"][0])   # (B, N)
+    n = y.shape[1]
+    half = n // 2
+    out = jnp.zeros_like(x)
+    for j in range(n):
+        out = out + jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]
+    return {"Out": [out]}

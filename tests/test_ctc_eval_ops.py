@@ -1,0 +1,330 @@
+"""CTC family + eval op tests (reference: test_warpctc_op.py,
+test_ctc_align.py, test_edit_distance_op.py, test_chunk_eval_op.py,
+test_precision_recall_op.py, test_positive_negative_pair_op.py).
+
+LoD inputs follow the padded+@SEQLEN convention, fed as packed LoDTensors.
+The CTC loss is checked against a brute-force path-enumeration oracle."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu.executor import LoDTensor
+
+RNG = np.random.RandomState(3)
+
+
+def make_lod(rows):
+    flat = np.concatenate(rows, axis=0)
+    offs = [0]
+    for r in rows:
+        offs.append(offs[-1] + len(r))
+    return LoDTensor(flat, [offs])
+
+
+def run_op(op_type, inputs, attrs, fetch_slots, lod_inputs=(), grad_of=None):
+    """Build a one-op program; inputs mapping slot -> (name, array|LoDTensor)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    feed = {}
+    with fluid.program_guard(main, startup):
+        op_inputs = {}
+        for slot, (name, val) in inputs.items():
+            arr = val.array() if isinstance(val, LoDTensor) else np.asarray(val)
+            v = main.global_block().create_var(
+                name=name, shape=list(arr.shape), dtype=arr.dtype.name,
+                lod_level=1 if isinstance(val, LoDTensor) else 0,
+                stop_gradient=False)
+            feed[name] = val
+            op_inputs[slot] = [name]
+        op_outputs = {}
+        out_names = {}
+        for slot in fetch_slots:
+            name = f"{op_type}_{slot.lower().replace('-', '_')}_out"
+            main.global_block().create_var(name=name, dtype="float32")
+            op_outputs[slot] = [name]
+            out_names[slot] = name
+        main.global_block().append_op(
+            type=op_type, inputs=op_inputs, outputs=op_outputs, attrs=attrs)
+        loss = None
+        if grad_of is not None:
+            loss = fluid.layers.mean(out_names_var(main, out_names[grad_of[1]]))
+            fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    with executor_mod.scope_guard(scope):
+        fetch = [out_names[s] for s in fetch_slots]
+        if grad_of is not None:
+            fetch.append(fluid.framework.grad_var_name(grad_of[0]))
+        res = exe.run(main, feed=feed, fetch_list=fetch, return_numpy=False)
+    return dict(zip(fetch_slots + ([f"{grad_of[0]}@GRAD"] if grad_of else []),
+                    res))
+
+
+def out_names_var(main, name):
+    return main.global_block().var(name)
+
+
+# --- CTC loss oracle ---------------------------------------------------------
+
+def ctc_loss_brute(probs, label, blank):
+    """Enumerate all length-T paths, sum probabilities of those collapsing to
+    the label (exponential — only for tiny T/C)."""
+    t, c = probs.shape
+    total = 0.0
+    for path in itertools.product(range(c), repeat=t):
+        collapsed = []
+        prev = -1
+        for p in path:
+            if p != blank and p != prev:
+                collapsed.append(p)
+            prev = p
+        if collapsed == list(label):
+            pr = 1.0
+            for i, p in enumerate(path):
+                pr *= probs[i, p]
+            total += pr
+    return -np.log(max(total, 1e-300))
+
+
+class TestWarpCTC:
+    def test_vs_bruteforce(self):
+        t, c = 4, 3
+        logits = RNG.randn(2, t, c).astype(np.float32)
+        labels = [np.array([[1], [2]], np.int64),
+                  np.array([[2]], np.int64)]
+        rows_logits = [logits[0], logits[1, :3]]   # lengths 4, 3
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="logits", shape=[c], dtype="float32",
+                                  lod_level=1)
+            lbl = fluid.layers.data(name="label", shape=[1], dtype="int64",
+                                    lod_level=1)
+            loss = fluid.layers.warpctc(input=x, label=lbl, blank=0)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = executor_mod.Scope()
+            with executor_mod.scope_guard(scope):
+                res, = exe.run(fluid.default_main_program(),
+                               feed={"logits": make_lod(rows_logits),
+                                     "label": make_lod(labels)},
+                               fetch_list=[loss])
+        def softmax(z):
+            e = np.exp(z - z.max(-1, keepdims=True))
+            return e / e.sum(-1, keepdims=True)
+        want0 = ctc_loss_brute(softmax(rows_logits[0]), [1, 2], 0)
+        want1 = ctc_loss_brute(softmax(rows_logits[1]), [2], 0)
+        got = np.asarray(res).reshape(-1)
+        np.testing.assert_allclose(got, [want0, want1], rtol=1e-4)
+
+    def test_grad_descends(self):
+        """Training on the CTC loss should reduce it (analytic grad sanity)."""
+        t, c, h = 5, 4, 6
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[h], dtype="float32",
+                                  lod_level=1)
+            lbl = fluid.layers.data(name="label", shape=[1], dtype="int64",
+                                    lod_level=1)
+            proj = fluid.layers.fc(input=x, size=c, num_flatten_dims=2)
+            loss = fluid.layers.warpctc(input=proj, label=lbl, blank=0)
+            avg = fluid.layers.mean(loss)
+            fluid.optimizer.SGDOptimizer(learning_rate=0.5).minimize(avg)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = executor_mod.Scope()
+            with executor_mod.scope_guard(scope):
+                exe.run(fluid.default_startup_program())
+                feed = {"x": make_lod([RNG.randn(t, h).astype(np.float32)]),
+                        "label": make_lod([np.array([[1], [2]], np.int64)])}
+                first = None
+                for i in range(12):
+                    v, = exe.run(fluid.default_main_program(), feed=feed,
+                                 fetch_list=[avg])
+                    first = first if first is not None else float(np.asarray(v).reshape(-1)[0])
+                assert float(np.asarray(v).reshape(-1)[0]) < first * 0.8
+
+
+class TestCTCAlign:
+    def test_merge_and_blank(self):
+        rows = [np.array([[0], [1], [1], [0], [2], [2]], np.int32),
+                np.array([[3], [0], [3]], np.int32)]
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[1], dtype="int32",
+                                  lod_level=1)
+            out = fluid.layers.ctc_align(x, blank=0)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = executor_mod.Scope()
+            with executor_mod.scope_guard(scope):
+                res, = exe.run(fluid.default_main_program(),
+                               feed={"x": make_lod(rows)},
+                               fetch_list=[out], return_numpy=False)
+        got = res
+        assert isinstance(got, LoDTensor)
+        lod = got.lod[0]
+        arr = got.array()
+        seqs = [arr[lod[i]:lod[i + 1]].reshape(-1).tolist()
+                for i in range(len(lod) - 1)]
+        assert seqs == [[1, 2], [3, 3]]
+
+
+class TestEditDistance:
+    def test_vs_oracle(self):
+        hyps = [np.array([[1], [2], [3]], np.int64),
+                np.array([[5], [5]], np.int64)]
+        refs = [np.array([[1], [3]], np.int64),
+                np.array([[5], [6], [7]], np.int64)]
+
+        def lev(a, b):
+            m, n = len(a), len(b)
+            d = np.zeros((m + 1, n + 1))
+            d[:, 0] = np.arange(m + 1)
+            d[0, :] = np.arange(n + 1)
+            for i in range(1, m + 1):
+                for j in range(1, n + 1):
+                    d[i, j] = min(d[i-1, j] + 1, d[i, j-1] + 1,
+                                  d[i-1, j-1] + (a[i-1] != b[j-1]))
+            return d[m, n]
+
+        for normalized in (False, True):
+            with fluid.program_guard(fluid.Program(), fluid.Program()):
+                h = fluid.layers.data(name="h", shape=[1], dtype="int64",
+                                      lod_level=1)
+                r = fluid.layers.data(name="r", shape=[1], dtype="int64",
+                                      lod_level=1)
+                dist, seq_num = fluid.layers.edit_distance(
+                    h, r, normalized=normalized)
+                exe = fluid.Executor(fluid.CPUPlace())
+                scope = executor_mod.Scope()
+                with executor_mod.scope_guard(scope):
+                    res, sn = exe.run(fluid.default_main_program(),
+                                      feed={"h": make_lod(hyps),
+                                            "r": make_lod(refs)},
+                                      fetch_list=[dist, seq_num])
+            want = np.array([
+                lev(hyps[i].reshape(-1), refs[i].reshape(-1))
+                for i in range(2)], np.float64)
+            if normalized:
+                want = want / np.array([2.0, 3.0])
+            np.testing.assert_allclose(np.asarray(res).reshape(-1), want,
+                                       rtol=1e-5)
+            assert int(np.asarray(sn).reshape(-1)[0]) == 2
+
+
+class TestChunkEval:
+    def _run(self, inf_rows, lab_rows, **attrs):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            inf = fluid.layers.data(name="inf", shape=[1], dtype="int64",
+                                    lod_level=1)
+            lab = fluid.layers.data(name="lab", shape=[1], dtype="int64",
+                                    lod_level=1)
+            (prec, rec, f1, n_inf, n_lab,
+             n_cor) = fluid.layers.chunk_eval(input=inf, label=lab, **attrs)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = executor_mod.Scope()
+            with executor_mod.scope_guard(scope):
+                return exe.run(
+                    fluid.default_main_program(),
+                    feed={"inf": make_lod(inf_rows),
+                          "lab": make_lod(lab_rows)},
+                    fetch_list=[prec, rec, f1, n_inf, n_lab, n_cor])
+
+    def test_iob(self):
+        # num_chunk_types=2, IOB: labels = type*2 + tag (B=0, I=1), O = 4
+        # label chunks: [B0 I0] [B1], inference: [B0 I0] [B0]
+        lab = [np.array([[0], [1], [4], [2]], np.int64)]
+        inf = [np.array([[0], [1], [4], [0]], np.int64)]
+        p, r, f1, ni, nl, nc = self._run(
+            inf, lab, chunk_scheme="IOB", num_chunk_types=2)
+        assert int(ni) == 2 and int(nl) == 2 and int(nc) == 1
+        np.testing.assert_allclose(float(p), 0.5)
+        np.testing.assert_allclose(float(r), 0.5)
+        np.testing.assert_allclose(float(f1), 0.5)
+
+    def test_plain_scheme_and_multiseq(self):
+        # plain: adjacent equal labels form ONE chunk; O = num_chunk_types
+        lab = [np.array([[1], [1], [3], [0]], np.int64),
+               np.array([[2], [3]], np.int64)]
+        inf = [np.array([[1], [1], [3], [3]], np.int64),
+               np.array([[2], [2]], np.int64)]
+        p, r, f1, ni, nl, nc = self._run(
+            inf, lab, chunk_scheme="plain", num_chunk_types=3)
+        # label chunks: {1:[0,1]},{0:[3]} in seq0 (3 is O), {2:[0]} in seq1
+        # inf chunks:   {1:[0,1]} in seq0, {2:[0,1]} in seq1
+        assert int(nl) == 3 and int(ni) == 2 and int(nc) == 1
+
+    def test_excluded(self):
+        lab = [np.array([[0], [2]], np.int64)]
+        inf = [np.array([[0], [2]], np.int64)]
+        p, r, f1, ni, nl, nc = self._run(
+            inf, lab, chunk_scheme="plain", num_chunk_types=4,
+            excluded_chunk_types=[0])
+        assert int(ni) == 2 and int(nl) == 2 and int(nc) == 1
+
+
+class TestPrecisionRecall:
+    def test_vs_oracle(self):
+        n, c = 12, 4
+        idx = RNG.randint(0, c, (n, 1)).astype(np.int32)
+        lab = RNG.randint(0, c, (n, 1)).astype(np.int32)
+        states = np.zeros((c, 4), np.float32)
+        for i in range(n):
+            p, t = int(idx[i]), int(lab[i])
+            if p == t:
+                states[p, 0] += 1
+                states[:, 2] += 1
+                states[p, 2] -= 1
+            else:
+                states[t, 3] += 1
+                states[p, 1] += 1
+                states[:, 2] += 1
+                states[p, 2] -= 1
+                states[t, 2] -= 1
+
+        def metrics(s):
+            def prec(tp, fp):
+                return tp / (tp + fp) if tp + fp > 0 else 1.0
+            def rec(tp, fn):
+                return tp / (tp + fn) if tp + fn > 0 else 1.0
+            def f1(p, r):
+                return 2 * p * r / (p + r) if p + r > 0 else 0.0
+            mp = np.mean([prec(s[i, 0], s[i, 1]) for i in range(c)])
+            mr = np.mean([rec(s[i, 0], s[i, 3]) for i in range(c)])
+            up = prec(s[:, 0].sum(), s[:, 1].sum())
+            ur = rec(s[:, 0].sum(), s[:, 3].sum())
+            return [mp, mr, f1(mp, mr), up, ur, f1(up, ur)]
+
+        res = run_op("precision_recall",
+                     {"Indices": ("pr_idx", idx), "Labels": ("pr_lab", lab)},
+                     {"class_number": c},
+                     ["BatchMetrics", "AccumMetrics", "AccumStatesInfo"])
+        np.testing.assert_allclose(np.asarray(res["BatchMetrics"]),
+                                   metrics(states), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(res["AccumStatesInfo"]),
+                                   states, rtol=1e-5)
+
+
+class TestPositiveNegativePair:
+    def test_vs_oracle(self):
+        score = np.array([[0.8], [0.2], [0.5], [0.4], [0.9]], np.float32)
+        label = np.array([[1], [0], [1], [0], [1]], np.float32)
+        query = np.array([[7], [7], [7], [8], [8]], np.int64)
+        pos = neg = neu = 0.0
+        for i in range(5):
+            for j in range(i + 1, 5):
+                if query[i] != query[j] or label[i] == label[j]:
+                    continue
+                ds = score[i, 0] - score[j, 0]
+                dl = label[i, 0] - label[j, 0]
+                if ds == 0:
+                    neu += 1
+                if ds * dl > 0:
+                    pos += 1
+                else:
+                    neg += 1
+        res = run_op("positive_negative_pair",
+                     {"Score": ("pnp_s", score), "Label": ("pnp_l", label),
+                      "QueryID": ("pnp_q", query)},
+                     {}, ["PositivePair", "NegativePair", "NeutralPair"])
+        assert float(np.asarray(res["PositivePair"])) == pos
+        assert float(np.asarray(res["NegativePair"])) == neg
+        assert float(np.asarray(res["NeutralPair"])) == neu

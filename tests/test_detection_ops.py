@@ -1,0 +1,358 @@
+"""Detection op + layer tests (reference: test_prior_box_op.py,
+test_iou_similarity_op.py, test_box_coder_op.py, test_bipartite_match_op.py,
+test_mine_hard_examples_op.py, test_target_assign_op.py,
+test_multiclass_nms_op.py, test_detection_map_op.py, plus an SSD-style
+acceptance test mirroring the book SSD config)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu.executor import LoDTensor
+
+RNG = np.random.RandomState(5)
+
+
+def make_lod(rows):
+    flat = np.concatenate(rows, axis=0)
+    offs = [0]
+    for r in rows:
+        offs.append(offs[-1] + len(r))
+    return LoDTensor(flat, [offs])
+
+
+def run(build, feed):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with executor_mod.scope_guard(executor_mod.Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=list(fetches),
+                       return_numpy=False)
+
+
+def np_iou(a, b):
+    ixmin = max(a[0], b[0]); iymin = max(a[1], b[1])
+    ixmax = min(a[2], b[2]); iymax = min(a[3], b[3])
+    iw = max(ixmax - ixmin, 0.0); ih = max(iymax - iymin, 0.0)
+    inter = iw * ih
+    a1 = (a[2] - a[0]) * (a[3] - a[1])
+    a2 = (b[2] - b[0]) * (b[3] - b[1])
+    return inter / max(a1 + a2 - inter, 1e-6)
+
+
+class TestPriorBox:
+    def test_vs_oracle(self):
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        img = np.zeros((1, 3, 32, 32), np.float32)
+        min_sizes, max_sizes = [4.0], [9.0]
+        ars, variance = [2.0], [0.1, 0.1, 0.2, 0.2]
+
+        def build():
+            f = fluid.layers.data(name="f", shape=[8, 2, 2],
+                                  dtype="float32")
+            im = fluid.layers.data(name="im", shape=[3, 32, 32],
+                                   dtype="float32")
+            boxes, var = fluid.layers.detection.prior_box(
+                f, im, min_sizes, max_sizes, ars, variance, flip=True)
+            return boxes, var
+
+        boxes, var = run(build, {"f": feat, "im": img})
+        boxes = np.asarray(boxes)
+        var = np.asarray(var)
+        # expanded ARs: [1, 2, 0.5]; priors = 3*1 + 1 = 4
+        assert boxes.shape == (2, 2, 4, 4)
+        # cell (0,0): center (8, 8) with step 16, offset .5
+        cx = cy = 8.0
+        m = min_sizes[0] / 2
+        np.testing.assert_allclose(
+            boxes[0, 0, 0], [(cx - m) / 32, (cy - m) / 32,
+                             (cx + m) / 32, (cy + m) / 32], rtol=1e-5)
+        s2 = math.sqrt(min_sizes[0] * max_sizes[0]) / 2
+        np.testing.assert_allclose(
+            boxes[0, 0, 1], [(cx - s2) / 32, (cy - s2) / 32,
+                             (cx + s2) / 32, (cy + s2) / 32], rtol=1e-5)
+        w2 = min_sizes[0] * math.sqrt(2.0) / 2
+        h2 = min_sizes[0] / math.sqrt(2.0) / 2
+        np.testing.assert_allclose(
+            boxes[0, 0, 2], [(cx - w2) / 32, (cy - h2) / 32,
+                             (cx + w2) / 32, (cy + h2) / 32], rtol=1e-5)
+        np.testing.assert_allclose(var[1, 1, 3], variance, rtol=1e-6)
+
+
+class TestIouSimilarity:
+    def test_vs_oracle(self):
+        x = np.abs(RNG.rand(4, 4)).astype("float32")
+        x[:, 2:] = x[:, :2] + np.abs(RNG.rand(4, 2)) + 0.1
+        y = np.abs(RNG.rand(3, 4)).astype("float32")
+        y[:, 2:] = y[:, :2] + np.abs(RNG.rand(3, 2)) + 0.1
+
+        def build():
+            xv = fluid.layers.data(name="x", shape=[4, 4], dtype="float32",
+                                   append_batch_size=False)
+            yv = fluid.layers.data(name="y", shape=[3, 4], dtype="float32",
+                                   append_batch_size=False)
+            return (fluid.layers.detection.iou_similarity(xv, yv),)
+
+        out, = run(build, {"x": x, "y": y})
+        want = np.array([[np_iou(a, b) for b in y] for a in x], np.float32)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4)
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        p = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.3, 0.7, 0.8]],
+                     np.float32)
+        pv = np.tile(np.array([0.1, 0.1, 0.2, 0.2], np.float32), (2, 1))
+        t = np.array([[0.15, 0.2, 0.4, 0.6]], np.float32)
+
+        def build_enc():
+            pb = fluid.layers.data(name="p", shape=[2, 4], dtype="float32",
+                                   append_batch_size=False)
+            pbv = fluid.layers.data(name="pv", shape=[2, 4], dtype="float32",
+                                    append_batch_size=False)
+            tb = fluid.layers.data(name="t", shape=[1, 4], dtype="float32",
+                                   append_batch_size=False)
+            enc = fluid.layers.detection.box_coder(pb, pbv, tb,
+                                                   "encode_center_size")
+            dec = fluid.layers.detection.box_coder(pb, pbv, enc,
+                                                   "decode_center_size")
+            return enc, dec
+
+        enc, dec = run(build_enc, {"p": p, "pv": pv, "t": t})
+        enc = np.asarray(enc)
+        dec = np.asarray(dec)
+        assert enc.shape == (1, 2, 4)
+        # decode(encode(t)) == t broadcast over priors
+        np.testing.assert_allclose(dec[0, 0], t[0], rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(dec[0, 1], t[0], rtol=1e-4, atol=1e-5)
+        # oracle for one encode cell
+        pw, ph = 0.4, 0.4
+        pcx, pcy = 0.3, 0.3
+        tcx, tcy = 0.275, 0.4
+        tw, th = 0.25, 0.4
+        want = [(tcx - pcx) / pw / 0.1, (tcy - pcy) / ph / 0.1,
+                math.log(tw / pw) / 0.2, math.log(th / ph) / 0.2]
+        np.testing.assert_allclose(enc[0, 0], want, rtol=1e-4, atol=1e-5)
+
+
+def bipartite_oracle(dist):
+    g, p = dist.shape
+    match = -np.ones(p, int)
+    mdist = np.zeros(p)
+    rows = set(range(g))
+    d = dist.copy()
+    while rows:
+        best = (-1, -1, -1.0)
+        for r in rows:
+            for c in range(p):
+                if match[c] == -1 and d[r, c] > best[2] and d[r, c] >= 1e-6:
+                    best = (r, c, d[r, c])
+        if best[0] < 0:
+            break
+        match[best[1]] = best[0]
+        mdist[best[1]] = best[2]
+        rows.remove(best[0])
+    return match, mdist
+
+
+class TestBipartiteMatch:
+    def test_vs_oracle(self):
+        rows = [RNG.rand(3, 5).astype("float32"),
+                RNG.rand(2, 5).astype("float32")]
+
+        def build():
+            d = fluid.layers.data(name="d", shape=[5], dtype="float32",
+                                  lod_level=1)
+            mi, md = fluid.layers.detection.bipartite_match(d)
+            return mi, md
+
+        mi, md = run(build, {"d": make_lod(rows)})
+        mi = np.asarray(mi)
+        md = np.asarray(md)
+        for b, r in enumerate(rows):
+            want_i, want_d = bipartite_oracle(r)
+            np.testing.assert_array_equal(mi[b], want_i)
+            np.testing.assert_allclose(md[b], want_d, rtol=1e-5)
+
+    def test_per_prediction(self):
+        dist = np.array([[0.8, 0.2, 0.6], [0.3, 0.7, 0.65]], np.float32)
+
+        def build():
+            d = fluid.layers.data(name="d", shape=[2, 3], dtype="float32",
+                                  append_batch_size=False)
+            mi, md = fluid.layers.detection.bipartite_match(
+                d, match_type="per_prediction", dist_threshold=0.5)
+            return mi, md
+
+        mi, md = run(build, {"d": dist})
+        # bipartite picks (0,0) and (1,1); col 2 argmax row 1 (0.65 >= 0.5)
+        np.testing.assert_array_equal(np.asarray(mi)[0], [0, 1, 1])
+
+
+class TestTargetAssign:
+    def test_basic(self):
+        x = RNG.rand(2, 3, 4).astype("float32")
+        match = np.array([[0, -1, 2, 1], [-1, 1, -1, 0]], np.int32)
+
+        def build():
+            xv = fluid.layers.data(name="x", shape=[2, 3, 4],
+                                   dtype="float32", append_batch_size=False)
+            mv = fluid.layers.data(name="m", shape=[2, 4], dtype="int32",
+                                   append_batch_size=False)
+            out, w = fluid.layers.detection.target_assign(
+                xv, mv, mismatch_value=0)
+            return out, w
+
+        out, w = run(build, {"x": x, "m": match})
+        out = np.asarray(out)
+        w = np.asarray(w)
+        for b in range(2):
+            for m in range(4):
+                if match[b, m] >= 0:
+                    np.testing.assert_allclose(out[b, m], x[b, match[b, m]],
+                                               rtol=1e-6)
+                    assert w[b, m, 0] == 1.0
+                else:
+                    assert (out[b, m] == 0).all() and w[b, m, 0] == 0.0
+
+
+def nms_oracle(boxes, scores, score_thr, nms_thr, top_k):
+    idx = np.argsort(-scores, kind="stable")
+    if top_k > -1:
+        idx = idx[:top_k]
+    keep = []
+    for i in idx:
+        if scores[i] <= score_thr:
+            continue
+        ok = True
+        for j in keep:
+            if np_iou(boxes[i], boxes[j]) > nms_thr:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+    return keep
+
+
+class TestMulticlassNMS:
+    def test_vs_oracle(self):
+        p, c = 6, 3
+        boxes = np.zeros((1, p, 4), np.float32)
+        for i in range(p):
+            x0, y0 = RNG.rand(2) * 0.5
+            boxes[0, i] = [x0, y0, x0 + 0.3 + RNG.rand() * 0.2,
+                           y0 + 0.3 + RNG.rand() * 0.2]
+        scores = RNG.rand(1, c, p).astype("float32")
+
+        def build():
+            b = fluid.layers.data(name="b", shape=[1, p, 4],
+                                  dtype="float32", append_batch_size=False)
+            s = fluid.layers.data(name="s", shape=[1, c, p],
+                                  dtype="float32", append_batch_size=False)
+            out = fluid.layers.detection.multiclass_nms(
+                b, s, background_label=0, score_threshold=0.1,
+                nms_threshold=0.4, keep_top_k=4)
+            return (out,)
+
+        out, = run(build, {"b": boxes, "s": scores})
+        got = out.array() if isinstance(out, LoDTensor) else np.asarray(out)
+        got = got.reshape(-1, 6)
+        lod = out.lod[0] if isinstance(out, LoDTensor) else None
+        n_det = (lod[1] - lod[0]) if lod is not None else got.shape[0]
+
+        # oracle: per-class NMS (skip class 0), global top-4 by score
+        cand = []
+        for cls in range(1, c):
+            for i in nms_oracle(boxes[0], scores[0, cls], 0.1, 0.4, -1):
+                cand.append((cls, scores[0, cls, i], i))
+        cand.sort(key=lambda t: -t[1])
+        cand = cand[:4]
+        assert n_det == len(cand)
+        for row, (cls, sc, i) in zip(got, cand):
+            assert int(row[0]) == cls
+            np.testing.assert_allclose(row[1], sc, rtol=1e-5)
+            np.testing.assert_allclose(row[2:], boxes[0, i], rtol=1e-5)
+
+
+class TestDetectionMAP:
+    def test_perfect_and_half(self):
+        # 1 image, 2 gt boxes of class 1 and 2; detections: exact hit on
+        # class 1, a miss (wrong location) on class 2
+        gt = np.array([[[1, 0, 0.1, 0.1, 0.4, 0.4],
+                        [2, 0, 0.5, 0.5, 0.9, 0.9]]], np.float32)
+        det_perfect = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                                 [2, 0.8, 0.5, 0.5, 0.9, 0.9]]], np.float32)
+        det_half = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                              [2, 0.8, 0.0, 0.0, 0.05, 0.05]]], np.float32)
+
+        def build(det_name):
+            d = fluid.layers.data(name=det_name, shape=[1, 2, 6],
+                                  dtype="float32", append_batch_size=False)
+            g = fluid.layers.data(name="g", shape=[1, 2, 6],
+                                  dtype="float32", append_batch_size=False)
+            m = fluid.layers.detection.detection_map(
+                d, g, overlap_threshold=0.5, ap_version="integral",
+                background_label=0)
+            return (m,)
+
+        m1, = run(lambda: build("d1"), {"d1": det_perfect, "g": gt})
+        m2, = run(lambda: build("d2"), {"d2": det_half, "g": gt})
+        np.testing.assert_allclose(float(np.asarray(m1)[0]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(np.asarray(m2)[0]), 0.5, atol=1e-6)
+
+
+class TestSSDAcceptance:
+    def test_ssd_loss_builds_and_descends(self):
+        """Tiny SSD: multi_box_head over two feature maps + ssd_loss; one
+        optimizer step must run and reduce the loss (reference book SSD
+        config, layers/detection.py:350)."""
+        B, C = 2, 4
+        img_np = RNG.rand(B, 3, 32, 32).astype("float32")
+        gt_boxes = [np.array([[0.1, 0.1, 0.45, 0.45]], np.float32),
+                    np.array([[0.5, 0.5, 0.9, 0.9],
+                              [0.2, 0.6, 0.5, 0.95]], np.float32)]
+        gt_labels = [np.array([[1]], np.int64),
+                     np.array([[2], [3]], np.int64)]
+
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                    dtype="float32")
+            gb = fluid.layers.data(name="gt_box", shape=[4], dtype="float32",
+                                   lod_level=1)
+            gl = fluid.layers.data(name="gt_label", shape=[1], dtype="int64",
+                                   lod_level=1)
+            c1 = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                     stride=2, padding=1, act="relu")
+            c2 = fluid.layers.conv2d(c1, num_filters=8, filter_size=3,
+                                     stride=2, padding=1, act="relu")
+            loc, conf, boxes, variances = \
+                fluid.layers.detection.multi_box_head(
+                    inputs=[c1, c2], image=img, base_size=32,
+                    num_classes=C, aspect_ratios=[[2.0], [2.0]],
+                    min_sizes=[4.0, 8.0], max_sizes=[8.0, 16.0],
+                    flip=True, clip=True)
+            loss = fluid.layers.detection.ssd_loss(
+                loc, conf, gb, gl, boxes, variances)
+            avg = fluid.layers.reduce_mean(loss)
+            opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+            opt.minimize(avg)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {"img": img_np, "gt_box": make_lod(gt_boxes),
+                "gt_label": make_lod(gt_labels)}
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(startup)
+            losses = []
+            for _ in range(8):
+                v, = exe.run(main, feed=feed, fetch_list=[avg])
+                losses.append(float(np.asarray(v).reshape(-1)[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]

@@ -1,0 +1,235 @@
+"""Misc + LoD-array op tests (reference: test_assign_value_op.py,
+test_fill_op.py, test_minus_op.py, test_modified_huber_loss_op.py,
+test_l1_norm_op.py, test_lod_tensor_array_ops.py, test_split_and_merge_
+lod_tensor_op.py, test_reorder_lod_tensor.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu.executor import LoDTensor
+from op_test import OpTest
+
+RNG = np.random.RandomState(11)
+
+
+def make_lod(rows):
+    flat = np.concatenate(rows, axis=0)
+    offs = [0]
+    for r in rows:
+        offs.append(offs[-1] + len(r))
+    return LoDTensor(flat, [offs])
+
+
+class TestAssignValue(OpTest):
+    op_type = "assign_value"
+
+    def test(self):
+        vals = RNG.rand(2, 3).astype("float32")
+        self.inputs = {}
+        self.attrs = {"shape": [2, 3], "dtype": "float32",
+                      "fp32_values": vals.reshape(-1).tolist()}
+        self.outputs = {"Out": vals}
+        self.check_output()
+
+
+class TestFill(OpTest):
+    op_type = "fill"
+
+    def test(self):
+        vals = RNG.rand(6).astype("float32")
+        self.inputs = {}
+        self.attrs = {"shape": [2, 3], "dtype": "float32",
+                      "value": vals.tolist()}
+        self.outputs = {"Out": vals.reshape(2, 3)}
+        self.check_output()
+
+
+class TestMinus(OpTest):
+    op_type = "minus"
+
+    def test(self):
+        x = RNG.rand(3, 4).astype("float32")
+        y = RNG.rand(3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestModifiedHuberLoss(OpTest):
+    op_type = "modified_huber_loss"
+
+    def test(self):
+        x = RNG.uniform(-2.5, 2.5, (8, 1)).astype("float32")
+        y = RNG.randint(0, 2, (8, 1)).astype("float32")
+        a = x * (2 * y - 1)
+        # keep numeric grad away from the kinks at -1 and 1
+        x[np.abs(np.abs(a) - 1) < 0.15] *= 1.4
+        a = x * (2 * y - 1)
+        loss = np.where(a < -1, -4 * a, np.where(a < 1, (1 - a) ** 2, 0))
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"IntermediateVal": a, "Out": loss}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestL1Norm(OpTest):
+    op_type = "l1_norm"
+
+    def test(self):
+        x = (RNG.rand(5, 3).astype("float32") - 0.5)
+        x[np.abs(x) < 0.05] = 0.2
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.array([np.abs(x).sum()], "float32")}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestSaveLoadOps:
+    def test_roundtrip(self):
+        val = RNG.rand(3, 4).astype("float32")
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "var0.save")
+            main = fluid.Program()
+            with fluid.program_guard(main, fluid.Program()):
+                x = fluid.layers.data(name="x", shape=[3, 4], dtype="float32",
+                                      append_batch_size=False)
+                main.global_block().append_op(
+                    type="save", inputs={"X": [x]}, outputs={},
+                    attrs={"file_path": path})
+                # a fetchable op so the program has an output
+                out = fluid.layers.scale(x, scale=1.0)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = executor_mod.Scope()
+            with executor_mod.scope_guard(scope):
+                exe.run(main, feed={"x": val}, fetch_list=[out])
+            assert os.path.exists(path)
+
+            main2 = fluid.Program()
+            with fluid.program_guard(main2, fluid.Program()):
+                y = main2.global_block().create_var(
+                    name="y_loaded", shape=[3, 4], dtype="float32")
+                main2.global_block().append_op(
+                    type="load", inputs={}, outputs={"Out": [y]},
+                    attrs={"file_path": path})
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                got, = exe.run(main2, feed={}, fetch_list=[y])
+            np.testing.assert_allclose(np.asarray(got), val)
+
+    def test_combine_roundtrip(self):
+        a = RNG.rand(2, 2).astype("float32")
+        b = RNG.rand(4).astype("float32")
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "combined")
+            main = fluid.Program()
+            with fluid.program_guard(main, fluid.Program()):
+                va = fluid.layers.data(name="a", shape=[2, 2],
+                                       dtype="float32",
+                                       append_batch_size=False)
+                vb = fluid.layers.data(name="b", shape=[4], dtype="float32",
+                                       append_batch_size=False)
+                main.global_block().append_op(
+                    type="save_combine", inputs={"X": [va, vb]}, outputs={},
+                    attrs={"file_path": path})
+                out = fluid.layers.scale(va, scale=1.0)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                exe.run(main, feed={"a": a, "b": b}, fetch_list=[out])
+
+            main2 = fluid.Program()
+            with fluid.program_guard(main2, fluid.Program()):
+                va2 = main2.global_block().create_var(
+                    name="a", shape=[2, 2], dtype="float32")
+                vb2 = main2.global_block().create_var(
+                    name="b", shape=[4], dtype="float32")
+                main2.global_block().append_op(
+                    type="load_combine", inputs={},
+                    outputs={"Out": [va2, vb2]},
+                    attrs={"file_path": path})
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                ga, gb = exe.run(main2, feed={}, fetch_list=[va2, vb2])
+            np.testing.assert_allclose(np.asarray(ga), a)
+            np.testing.assert_allclose(np.asarray(gb), b)
+
+
+class TestLoDArrayRoundtrip:
+    def test_to_array_and_back(self):
+        rows = [RNG.randn(n, 3).astype(np.float32) for n in (2, 4, 1)]
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                                  lod_level=1)
+            table = fluid.layers.lod_rank_table(x)
+            arr = fluid.layers.lod_tensor_to_array(x, table)
+            back = fluid.layers.array_to_lod_tensor(arr, table)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                got, = exe.run(fluid.default_main_program(),
+                               feed={"x": make_lod(rows)},
+                               fetch_list=[back], return_numpy=False)
+        lod = got.lod[0]
+        arr_np = got.array()
+        for i, r in enumerate(rows):
+            np.testing.assert_allclose(arr_np[lod[i]:lod[i + 1]], r,
+                                       rtol=1e-6)
+
+    def test_max_sequence_len(self):
+        rows = [RNG.randn(n, 2).astype(np.float32) for n in (3, 5, 2)]
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                                  lod_level=1)
+            table = fluid.layers.lod_rank_table(x)
+            mlen = fluid.layers.max_sequence_len(table)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                got, = exe.run(fluid.default_main_program(),
+                               feed={"x": make_lod(rows)},
+                               fetch_list=[mlen])
+        assert int(np.asarray(got).reshape(-1)[0]) == 5
+
+
+class TestSplitMergeLoDTensor:
+    def test_roundtrip(self):
+        x_np = RNG.randn(5, 3).astype(np.float32)
+        mask_np = np.array([[1], [0], [1], [1], [0]], "int32")
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[5, 3], dtype="float32",
+                                  append_batch_size=False)
+            m = fluid.layers.data(name="m", shape=[5, 1], dtype="int32",
+                                  append_batch_size=False)
+            t, f = fluid.layers.split_lod_tensor(x, m)
+            merged = fluid.layers.merge_lod_tensor(t, f, x, m)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                tt, ff, mm = exe.run(fluid.default_main_program(),
+                                     feed={"x": x_np, "m": mask_np},
+                                     fetch_list=[t, f, merged])
+        sel = mask_np.reshape(-1).astype(bool)
+        np.testing.assert_allclose(np.asarray(tt)[sel], x_np[sel])
+        np.testing.assert_allclose(np.asarray(ff)[~sel], x_np[~sel])
+        assert (np.asarray(tt)[~sel] == 0).all()
+        np.testing.assert_allclose(np.asarray(mm), x_np)
+
+
+class TestReorderLoDTensorByRank:
+    def test_reorder(self):
+        rows = [RNG.randn(n, 2).astype(np.float32) for n in (2, 5, 3)]
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                                  lod_level=1)
+            table = fluid.layers.lod_rank_table(x)
+            out = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                got, = exe.run(fluid.default_main_program(),
+                               feed={"x": make_lod(rows)},
+                               fetch_list=[out], return_numpy=False)
+        lod = got.lod[0]
+        arr = got.array()
+        # descending length order: rows[1] (5), rows[2] (3), rows[0] (2)
+        want = [rows[1], rows[2], rows[0]]
+        for i, w in enumerate(want):
+            np.testing.assert_allclose(arr[lod[i]:lod[i + 1]], w, rtol=1e-6)

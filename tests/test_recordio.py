@@ -53,3 +53,17 @@ def test_crc_detects_corruption(tmp_path):
     import pytest
     with pytest.raises(IOError):
         list(recordio.RecordIOScanner(path))
+
+
+def test_highly_compressible_chunk_not_flagged_corrupt(tmp_path):
+    """zlib can legitimately reach ~1030:1 on redundant data; the corruption
+    guard must not reject such chunks (cap is 1200, above deflate's max)."""
+    import numpy as np
+    from paddle_tpu import recordio
+    path = str(tmp_path / "zeros.recordio")
+    payload = b"\x00" * (1 << 20)   # 1 MiB of zeros -> ~1000:1 deflate
+    w = recordio.RecordIOWriter(path)
+    w.write(payload)
+    w.close()
+    got = list(recordio.RecordIOScanner(path))
+    assert len(got) == 1 and got[0] == payload

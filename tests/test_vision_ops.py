@@ -1,0 +1,322 @@
+"""Vision op family tests (reference: test_pool3d_op.py, test_pool_max_op.py,
+test_unpool_op.py, test_spp_op.py, test_roi_pool_op.py, test_crop_op.py,
+test_conv3d_transpose_op.py, test_prelu_op.py, test_conv_shift_op.py)."""
+
+import itertools
+import math
+
+import numpy as np
+
+from op_test import OpTest
+
+RNG = np.random.RandomState(42)
+
+
+def well_separated(*shape):
+    """Distinct values with pairwise gaps >> the numeric-grad delta, so
+    max-pool argmaxes cannot flip under perturbation."""
+    n = int(np.prod(shape))
+    return (RNG.permutation(n).astype("float32") / n).reshape(shape)
+
+
+def _pool3d_np(x, k, s, p, ptype, exclusive=True):
+    n, c, d, h, w = x.shape
+    od = (d - k[0] + 2 * p[0]) // s[0] + 1
+    oh = (h - k[1] + 2 * p[1]) // s[1] + 1
+    ow = (w - k[2] + 2 * p[2]) // s[2] + 1
+    out = np.zeros((n, c, od, oh, ow), x.dtype)
+    for zd, zh, zw in itertools.product(range(od), range(oh), range(ow)):
+        d0, d1 = max(zd * s[0] - p[0], 0), min(zd * s[0] - p[0] + k[0], d)
+        h0, h1 = max(zh * s[1] - p[1], 0), min(zh * s[1] - p[1] + k[1], h)
+        w0, w1 = max(zw * s[2] - p[2], 0), min(zw * s[2] - p[2] + k[2], w)
+        win = x[:, :, d0:d1, h0:h1, w0:w1]
+        if ptype == "max":
+            out[:, :, zd, zh, zw] = win.max(axis=(2, 3, 4))
+        else:
+            denom = win[0, 0].size if exclusive else k[0] * k[1] * k[2]
+            out[:, :, zd, zh, zw] = win.sum(axis=(2, 3, 4)) / denom
+    return out
+
+
+class TestPool3dMax(OpTest):
+    op_type = "pool3d"
+
+    def setup(self):
+        x = np.random.rand(2, 3, 5, 6, 5).astype("float32")
+        self.attrs = {"pooling_type": "max", "ksize": [2, 3, 2],
+                      "strides": [1, 2, 2], "paddings": [0, 1, 0]}
+        self.inputs = {"X": x}
+        self.outputs = {"Out": _pool3d_np(x, [2, 3, 2], [1, 2, 2],
+                                          [0, 1, 0], "max")}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.inputs["X"] = well_separated(1, 1, 3, 4, 3)
+        self.outputs["Out"] = _pool3d_np(self.inputs["X"], [2, 3, 2],
+                                         [1, 2, 2], [0, 1, 0], "max")
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestPool3dAvg(OpTest):
+    op_type = "pool3d"
+
+    def test(self):
+        x = np.random.rand(2, 2, 4, 5, 4).astype("float32")
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2, 2],
+                      "strides": [2, 2, 2], "paddings": [0, 0, 0],
+                      "exclusive": False}
+        self.inputs = {"X": x}
+        self.outputs = {"Out": _pool3d_np(x, [2, 2, 2], [2, 2, 2],
+                                          [0, 0, 0], "avg", exclusive=False)}
+        self.check_output()
+
+
+def _max_pool2d_index_np(x, k, s, p):
+    n, c, h, w = x.shape
+    oh = (h - k[0] + 2 * p[0]) // s[0] + 1
+    ow = (w - k[1] + 2 * p[1]) // s[1] + 1
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    mask = np.zeros((n, c, oh, ow), "int32")
+    for zh, zw in itertools.product(range(oh), range(ow)):
+        h0, h1 = max(zh * s[0] - p[0], 0), min(zh * s[0] - p[0] + k[0], h)
+        w0, w1 = max(zw * s[1] - p[1], 0), min(zw * s[1] - p[1] + k[1], w)
+        win = x[:, :, h0:h1, w0:w1].reshape(n, c, -1)
+        am = win.argmax(axis=2)
+        out[:, :, zh, zw] = win.max(axis=2)
+        wlen = w1 - w0
+        mask[:, :, zh, zw] = (h0 + am // wlen) * w + (w0 + am % wlen)
+    return out, mask
+
+
+class TestMaxPool2dWithIndex(OpTest):
+    op_type = "max_pool2d_with_index"
+
+    def test(self):
+        x = np.random.rand(2, 3, 6, 6).astype("float32")
+        k, s, p = [3, 3], [2, 2], [1, 1]
+        out, mask = _max_pool2d_index_np(x, k, s, p)
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": k, "strides": s, "paddings": p}
+        self.outputs = {"Out": out, "Mask": mask}
+        self.check_output()
+        self.inputs["X"] = well_separated(1, 2, 4, 4)
+        o2, m2 = _max_pool2d_index_np(self.inputs["X"], k, s, p)
+        self.outputs = {"Out": o2, "Mask": m2}
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestMaxPool3dWithIndex(OpTest):
+    op_type = "max_pool3d_with_index"
+
+    def test(self):
+        x = np.random.rand(1, 2, 4, 5, 4).astype("float32")
+        k, s, p = [2, 2, 2], [2, 2, 2], [0, 0, 0]
+        n, c, d, h, w = x.shape
+        od, oh, ow = d // 2, (h - 2) // 2 + 1, w // 2
+        out = np.zeros((n, c, od, oh, ow), x.dtype)
+        mask = np.zeros((n, c, od, oh, ow), "int32")
+        for zd, zh, zw in itertools.product(range(od), range(oh), range(ow)):
+            win = x[:, :, zd*2:zd*2+2, zh*2:zh*2+2, zw*2:zw*2+2]
+            flat = win.reshape(n, c, -1)
+            am = flat.argmax(axis=2)
+            out[:, :, zd, zh, zw] = flat.max(axis=2)
+            di = zd * 2 + am // 4
+            hi = zh * 2 + (am % 4) // 2
+            wi = zw * 2 + am % 2
+            mask[:, :, zd, zh, zw] = (di * h + hi) * w + wi
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": k, "strides": s, "paddings": p}
+        self.outputs = {"Out": out, "Mask": mask}
+        self.check_output()
+
+
+class TestUnpool(OpTest):
+    op_type = "unpool"
+
+    def test(self):
+        x = np.random.rand(1, 2, 2, 2).astype("float32")
+        # valid disjoint flat indices into a 4x4 plane
+        idx = np.array([[[[0, 3], [9, 14]], [[1, 6], [8, 15]]]], "int32")
+        out = np.zeros((1, 2, 4, 4), "float32")
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    f = idx[0, c, i, j]
+                    out[0, c, f // 4, f % 4] = x[0, c, i, j]
+        self.inputs = {"X": x, "Indices": idx}
+        self.attrs = {"unpooled_size": [4, 4]}
+        self.outputs = {"Out": out}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSpp(OpTest):
+    op_type = "spp"
+
+    def test(self):
+        x = np.random.rand(2, 3, 7, 9).astype("float32")
+        n, c, h, w = x.shape
+        levels = []
+        for level in range(2):
+            b = 2 ** level
+            kh, kw = math.ceil(h / b), math.ceil(w / b)
+            ph = (kh * b - h + 1) // 2
+            pw = (kw * b - w + 1) // 2
+            o = np.full((n, c, b, b), -np.inf, "float32")
+            for zh, zw in itertools.product(range(b), range(b)):
+                h0, h1 = max(zh * kh - ph, 0), min(zh * kh - ph + kh, h)
+                w0, w1 = max(zw * kw - pw, 0), min(zw * kw - pw + kw, w)
+                o[:, :, zh, zw] = x[:, :, h0:h1, w0:w1].max(axis=(2, 3))
+            levels.append(o.reshape(n, -1))
+        self.inputs = {"X": x}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+        self.outputs = {"Out": np.concatenate(levels, axis=1)}
+        self.check_output()
+
+
+def _roi_pool_np(x, rois, bid, scale, ph, pw):
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    out = np.zeros((r, c, ph, pw), x.dtype)
+    for ri in range(r):
+        x1, y1, x2, y2 = np.round(rois[ri] * scale).astype(int)
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for pi in range(ph):
+            for pj in range(pw):
+                h0 = min(max(y1 + (pi * rh) // ph, 0), h)
+                h1 = min(max(y1 + ((pi + 1) * rh + ph - 1) // ph, 0), h)
+                w0 = min(max(x1 + (pj * rw) // pw, 0), w)
+                w1 = min(max(x1 + ((pj + 1) * rw + pw - 1) // pw, 0), w)
+                if h1 > h0 and w1 > w0:
+                    out[ri, :, pi, pj] = \
+                        x[bid[ri], :, h0:h1, w0:w1].max(axis=(1, 2))
+    return out
+
+
+class TestRoiPool(OpTest):
+    op_type = "roi_pool"
+
+    def test(self):
+        x = np.random.rand(2, 3, 8, 8).astype("float32")
+        rois = np.array([[1, 1, 6, 6], [0, 2, 7, 7], [2, 0, 3, 3]], "float32")
+        bid = np.array([0, 1, 1], "int32")
+        out = _roi_pool_np(x, rois, bid, 1.0, 3, 3)
+        self.inputs = {"X": x, "ROIs": rois, "RoiBatchId": bid}
+        self.attrs = {"spatial_scale": 1.0, "pooled_height": 3,
+                      "pooled_width": 3}
+        self.outputs = {"Out": out}
+        self.check_output(no_check_set=("Argmax",))
+        # grad: tiny case
+        self.inputs = {"X": well_separated(1, 1, 4, 4),
+                       "ROIs": np.array([[0, 0, 3, 3]], "float32"),
+                       "RoiBatchId": np.array([0], "int32")}
+        self.attrs = {"spatial_scale": 1.0, "pooled_height": 2,
+                      "pooled_width": 2}
+        self.outputs = {"Out": _roi_pool_np(
+            self.inputs["X"], self.inputs["ROIs"],
+            self.inputs["RoiBatchId"], 1.0, 2, 2)}
+        self.check_grad(["X"], "Out", max_relative_error=0.02)
+
+
+class TestCrop(OpTest):
+    op_type = "crop"
+
+    def test(self):
+        x = np.random.rand(4, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [2, 3], "offsets": [1, 2]}
+        self.outputs = {"Out": x[1:3, 2:5]}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestCropWithOffsetsInput(OpTest):
+    op_type = "crop"
+
+    def test(self):
+        x = np.random.rand(4, 6).astype("float32")
+        off = np.array([1, 2], "int32")
+        self.inputs = {"X": x, "Offsets": off}
+        self.attrs = {"shape": [2, 3]}
+        self.outputs = {"Out": x[1:3, 2:5]}
+        self.check_output()
+
+
+def _conv3d_transpose_np(x, w, s, p):
+    n, cin, d, h, wd = x.shape
+    _, cout, kd, kh, kw = w.shape
+    od = s[0] * (d - 1) + kd - 2 * p[0]
+    oh = s[1] * (h - 1) + kh - 2 * p[1]
+    ow = s[2] * (wd - 1) + kw - 2 * p[2]
+    out = np.zeros((n, cout, od + 2 * p[0], oh + 2 * p[1], ow + 2 * p[2]),
+                   x.dtype)
+    for ni, ci, zd, zh, zw in itertools.product(
+            range(n), range(cin), range(d), range(h), range(wd)):
+        out[ni, :, zd*s[0]:zd*s[0]+kd, zh*s[1]:zh*s[1]+kh,
+            zw*s[2]:zw*s[2]+kw] += x[ni, ci, zd, zh, zw] * w[ci]
+    if p != [0, 0, 0]:
+        out = out[:, :, p[0]:p[0]+od, p[1]:p[1]+oh, p[2]:p[2]+ow]
+    return out
+
+
+class TestConv3dTranspose(OpTest):
+    op_type = "conv3d_transpose"
+
+    def test(self):
+        x = np.random.rand(1, 2, 3, 3, 3).astype("float32")
+        w = np.random.rand(2, 3, 2, 2, 2).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2, 2], "paddings": [1, 1, 1]}
+        self.outputs = {"Output": _conv3d_transpose_np(
+            x, w, [2, 2, 2], [1, 1, 1])}
+        self.check_output(atol=1e-4)
+        self.inputs = {"Input": np.random.rand(1, 1, 2, 2, 2).astype("float32"),
+                       "Filter": np.random.rand(1, 1, 2, 2, 2).astype("float32")}
+        self.attrs = {"strides": [1, 1, 1], "paddings": [0, 0, 0]}
+        self.outputs = {"Output": _conv3d_transpose_np(
+            self.inputs["Input"], self.inputs["Filter"],
+            [1, 1, 1], [0, 0, 0])}
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=0.02)
+
+
+class TestPrelu(OpTest):
+    op_type = "prelu"
+
+    def test(self):
+        x = (np.random.rand(3, 4) - 0.5).astype("float32")
+        x[np.abs(x) < 0.05] = 0.1   # keep away from the kink
+        for mode, a in (("all", np.array([0.25], "float32")),
+                        ("channel", np.random.rand(4).astype("float32")),
+                        ("element", np.random.rand(3, 4).astype("float32"))):
+            alpha = a.reshape(-1) if mode != "element" else a
+            if mode == "all":
+                ab = a[0]
+            elif mode == "channel":
+                ab = a[None, :]
+            else:
+                ab = a
+            self.inputs = {"X": x, "Alpha": alpha}
+            self.attrs = {"mode": mode}
+            self.outputs = {"Out": np.where(x > 0, x, ab * x)}
+            self.check_output()
+        self.check_grad(["X", "Alpha"], "Out")
+
+
+class TestConvShift(OpTest):
+    op_type = "conv_shift"
+
+    def test(self):
+        b, m, n = 2, 7, 3
+        x = np.random.rand(b, m).astype("float32")
+        y = np.random.rand(b, n).astype("float32")
+        out = np.zeros_like(x)
+        for i in range(m):
+            for j in range(n):
+                out[:, i] += x[:, (i + j - n // 2) % m] * y[:, j]
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.02)
