@@ -146,12 +146,34 @@ def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
 
 def calc_gradient(targets, inputs, target_gradients=None,
                   no_grad_set: Optional[Set[str]] = None):
-    """Gradients of `targets` w.r.t. `inputs` (reference backward.py:555)."""
-    targets = targets if isinstance(targets, (list, tuple)) else [targets]
-    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    assert len(targets) == 1, "calc_gradient supports a single target for now"
-    append_backward(targets[0], no_grad_set=no_grad_set)
-    block = targets[0].block.program.global_block()
+    """Gradients of `targets` w.r.t. `inputs` (reference backward.py:555).
+
+    Supports multiple targets and optional initial cotangents: the combined
+    gradient is built by differentiating sum_i reduce_sum(t_i * tg_i)
+    (tg_i = ones when absent), which by linearity of the vjp equals the
+    reference's multi-target accumulation. Like the reference, the grad ops
+    are appended to the targets' program."""
+    targets = list(targets) if isinstance(targets, (list, tuple)) else [targets]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is None:
+        target_gradients = [None] * len(targets)
+    elif not isinstance(target_gradients, (list, tuple)):
+        target_gradients = [target_gradients]
+    assert len(target_gradients) == len(targets), (
+        f"{len(targets)} targets but {len(target_gradients)} target grads")
+    program = targets[0].block.program
+    from .framework.framework import program_guard
+    from . import layers
+    with program_guard(program):
+        parts = []
+        for t, tg in zip(targets, target_gradients):
+            weighted = t if tg is None else layers.elementwise_mul(t, tg)
+            parts.append(layers.reduce_sum(weighted))
+        loss = parts[0]
+        for p in parts[1:]:
+            loss = layers.elementwise_add(loss, p)
+    append_backward(loss, no_grad_set=no_grad_set)
+    block = program.global_block()
     outs = []
     for v in inputs:
         gname = grad_var_name(v.name)

@@ -213,8 +213,16 @@ class LoweringContext:
         ride along the trace as an int32 array input."""
         return self.env.get(name + SEQLEN_SUFFIX)
 
+    def seq_len2(self, name: str):
+        """Inner lengths [batch, S] for a nested (lod_level=2) sequence var,
+        or None (reference lod_tensor.h:55 second offset level)."""
+        return self.env.get(name + SEQLEN2_SUFFIX)
+
     def set_seq_len(self, name: str, lengths):
         self.seq_overrides[name] = lengths
+
+    def set_seq_len2(self, name: str, lengths):
+        self.seq_overrides[name + SEQLEN2_SUFFIX] = lengths
 
     def next_rng(self, op=None):
         """Deterministic per-op PRNG key. Keyed on the op's first output name
@@ -250,6 +258,12 @@ _EAGER = os.environ.get("PADDLE_TPU_EAGER", "0") == "1"
 _CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
 
 SEQLEN_SUFFIX = "@SEQLEN"
+SEQLEN2_SUFFIX = "@SEQLEN2"   # inner lengths [B, S] of nested (level-2) LoD
+
+# ops with a native SelectedRows (sparse-rows) kernel; everything else
+# receives densified gradients (reference: only sum/sgd/adam register
+# SelectedRows variants)
+_SPARSE_AWARE_OPS = {"sum", "sgd"}
 
 
 def _bucket_len(n: int) -> int:
@@ -265,32 +279,62 @@ def _bucket_len(n: int) -> int:
 
 
 def pack_to_padded(flat: np.ndarray, lod: List[List[int]]):
-    """Packed [sum_len, ...] rows + level-1 LoD offsets -> padded
-    [batch, T, ...] plus int32 lengths [batch]. The dense/padded layout is
-    the XLA-friendly equivalent of the reference's zero-padding-free packed
-    LoDTensor (lod_tensor.h:107)."""
-    assert len(lod) == 1, (
-        "only lod_level==1 feeds are supported (nested sequences: pad "
-        "outer level host-side before feeding)")
-    offs = lod[0]
-    lengths = np.asarray([b - a for a, b in zip(offs[:-1], offs[1:])],
-                         dtype=np.int32)
-    bsz = len(lengths)
-    t = _bucket_len(int(lengths.max()) if bsz else 1)
-    padded = np.zeros((bsz, t) + tuple(flat.shape[1:]), dtype=flat.dtype)
-    for i, (a, b) in enumerate(zip(offs[:-1], offs[1:])):
-        padded[i, : b - a] = flat[a:b]
-    return padded, lengths
+    """Packed [sum_len, ...] rows + LoD offsets -> padded dense + lengths:
+    level-1 gives ([batch, T, ...], lengths [batch], None); level-2 nested
+    sequences (reference lod_tensor.h:55, RecurrentGradientMachine.h:32)
+    give ([batch, S, T, ...], outer lengths [batch], inner lengths
+    [batch, S]). The dense/padded layout is the XLA-friendly equivalent of
+    the reference's zero-padding-free packed LoDTensor."""
+    assert len(lod) in (1, 2), "lod_level must be 1 or 2"
+    if len(lod) == 1:
+        offs = lod[0]
+        lengths = np.asarray([b - a for a, b in zip(offs[:-1], offs[1:])],
+                             dtype=np.int32)
+        bsz = len(lengths)
+        t = _bucket_len(int(lengths.max()) if bsz else 1)
+        padded = np.zeros((bsz, t) + tuple(flat.shape[1:]), dtype=flat.dtype)
+        for i, (a, b) in enumerate(zip(offs[:-1], offs[1:])):
+            padded[i, : b - a] = flat[a:b]
+        return padded, lengths, None
+    outer, inner = lod
+    outer_lens = np.asarray([b - a for a, b in zip(outer[:-1], outer[1:])],
+                            dtype=np.int32)
+    inner_lens_flat = [inner[j + 1] - inner[j] for j in range(len(inner) - 1)]
+    bsz = len(outer_lens)
+    s_max = _bucket_len(int(outer_lens.max()) if bsz else 1)
+    t_max = _bucket_len(max(inner_lens_flat) if inner_lens_flat else 1)
+    padded = np.zeros((bsz, s_max, t_max) + tuple(flat.shape[1:]),
+                      dtype=flat.dtype)
+    inner_lens = np.zeros((bsz, s_max), dtype=np.int32)
+    for i in range(bsz):
+        for j in range(outer_lens[i]):
+            k = outer[i] + j
+            a, b = inner[k], inner[k + 1]
+            padded[i, j, : b - a] = flat[a:b]
+            inner_lens[i, j] = b - a
+    return padded, outer_lens, inner_lens
 
 
-def padded_to_pack(padded: np.ndarray, lengths: np.ndarray):
-    """Inverse of pack_to_padded: padded [B,T,...] + lengths -> packed rows +
-    LoD offsets (for fetch-side LoDTensor reconstruction)."""
-    rows = [padded[i, : int(l)] for i, l in enumerate(lengths)]
-    offs = [0]
-    for r in rows:
-        offs.append(offs[-1] + len(r))
-    return (np.concatenate(rows, axis=0) if rows else padded[:0, 0]), [offs]
+def padded_to_pack(padded: np.ndarray, lengths: np.ndarray,
+                   inner_lengths: Optional[np.ndarray] = None):
+    """Inverse of pack_to_padded: padded + lengths -> packed rows + LoD
+    offsets (for fetch-side LoDTensor reconstruction); with inner_lengths
+    the input is a nested [B, S, T, ...] batch and a 2-level LoD comes
+    back."""
+    if inner_lengths is None:
+        rows = [padded[i, : int(l)] for i, l in enumerate(lengths)]
+        offs = [0]
+        for r in rows:
+            offs.append(offs[-1] + len(r))
+        return (np.concatenate(rows, axis=0) if rows else padded[:0, 0]),             [offs]
+    outer_offs, inner_offs, rows = [0], [0], []
+    for i, ol in enumerate(lengths):
+        outer_offs.append(outer_offs[-1] + int(ol))
+        for j in range(int(ol)):
+            tl = int(inner_lengths[i, j])
+            rows.append(padded[i, j, :tl])
+            inner_offs.append(inner_offs[-1] + tl)
+    return (np.concatenate(rows, axis=0) if rows else padded[:0, 0, 0]),         [outer_offs, inner_offs]
 
 
 class _CompiledBlock:
@@ -317,7 +361,17 @@ class Executor:
             return_numpy: bool = True, use_program_cache: bool = True,
             use_jit: Optional[bool] = None):
         program = program if program is not None else default_main_program()
-        feed = feed or {}
+        feed = dict(feed or {})
+        # program-bound reader pipelines (layers.read_file): when the caller
+        # gives no explicit feed for the reader vars, pull the next
+        # (prefetched) batch — the executor-side half of the reference's
+        # reader ops (operators/reader/*.cc). Raises EOFException when a
+        # pass ends, matching the reference's drain loop idiom.
+        for reader, names in getattr(program, "_pipeline_readers", []):
+            if any(n in feed for n in names):
+                continue
+            batch_vals = reader.next_batch(self.device)
+            feed.update(dict(zip(names, batch_vals)))
         fetch_list = list(fetch_list or [])
         scope = scope if scope is not None else global_scope()
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
@@ -333,8 +387,10 @@ class Executor:
                 lod_map[name] = val.lod
                 arr = np.asarray(val.array())
                 if val.lod:
-                    arr, lengths = pack_to_padded(arr, val.lod)
+                    arr, lengths, inner = pack_to_padded(arr, val.lod)
                     feed_vals[name + SEQLEN_SUFFIX] = lengths
+                    if inner is not None:
+                        feed_vals[name + SEQLEN2_SUFFIX] = inner
                 feed_vals[name] = arr
             else:
                 feed_vals[name] = np.asarray(val) if not isinstance(
@@ -358,8 +414,10 @@ class Executor:
                 arr = np.asarray(v.array())
                 if v.lod:
                     # same padded+SEQLEN convention as LoD feeds
-                    arr, lengths = pack_to_padded(arr, v.lod)
+                    arr, lengths, inner = pack_to_padded(arr, v.lod)
                     state_vals[n + SEQLEN_SUFFIX] = lengths
+                    if inner is not None:
+                        state_vals[n + SEQLEN2_SUFFIX] = inner
                 v = arr
             state_vals[n] = v
 
@@ -384,19 +442,33 @@ class Executor:
             with jax.default_device(self.device):
                 fetch_vals, fetch_lens, new_state = compiled.fn(
                     feed_vals, state_vals, rng_key)
+            if _CHECK_NAN_INF:
+                # jit-path equivalent of the reference FLAGS_check_nan_inf
+                # per-op scan (executor.cc:325-333): inside one fused XLA
+                # computation there is no per-op boundary, so the check runs
+                # on every fetch and updated persistable after the step.
+                for name, val in list(zip(fetch_names, fetch_vals)) +                         list(new_state.items()):
+                    arr = np.asarray(val)
+                    if np.issubdtype(arr.dtype, np.floating) and                             not np.isfinite(arr).all():
+                        raise RuntimeError(
+                            f"NaN/Inf detected in variable '{name}' after "
+                            f"jitted step (PADDLE_TPU_CHECK_NAN_INF=1)")
         else:
             fetch_vals, fetch_lens, new_state = self._run_eager(
                 program, feed_vals, state_vals, fetch_names, persist_out,
                 rng_key, lod_map)
 
         for n, v in new_state.items():
-            if n.endswith(SEQLEN_SUFFIX):
+            if n.endswith(SEQLEN_SUFFIX) or n.endswith(SEQLEN2_SUFFIX):
                 continue
             if n + SEQLEN_SUFFIX in new_state:
                 # sequence state goes back to the scope as a LoDTensor so the
-                # next run re-packs it with its lengths intact
+                # next run re-packs it with its lengths intact (incl. the
+                # inner lengths of nested lod_level=2 state)
+                inner = new_state.get(n + SEQLEN2_SUFFIX)
                 packed, lod = padded_to_pack(
-                    np.asarray(v), np.asarray(new_state[n + SEQLEN_SUFFIX]))
+                    np.asarray(v), np.asarray(new_state[n + SEQLEN_SUFFIX]),
+                    None if inner is None else np.asarray(inner))
                 scope.set_var(n, LoDTensor(packed, lod))
             else:
                 scope.set_var(n, v)
@@ -406,6 +478,7 @@ class Executor:
         rebuilt = []
         for n, v in zip(fetch_names, fetch_vals):
             lens = fetch_lens.get(n)
+            inner = fetch_lens.get(n + SEQLEN2_SUFFIX)
             arr = np.asarray(v)
             if lens is not None:
                 lens = np.asarray(lens)
@@ -413,8 +486,13 @@ class Executor:
                 if arr.ndim < 2 or lens.shape[0] != arr.shape[0] or \
                         (lens.size and lens.max() > arr.shape[1]):
                     lens = None
+            if inner is not None and lens is not None:
+                inner = np.asarray(inner)
+                if arr.ndim < 3 or inner.shape[:2] != arr.shape[:2] or \
+                        (inner.size and inner.max() > arr.shape[2]):
+                    inner = None
             if lens is not None:
-                packed, lod = padded_to_pack(arr, lens)
+                packed, lod = padded_to_pack(arr, lens, inner)
                 rebuilt.append(np.asarray(packed) if return_numpy
                                else LoDTensor(packed, lod))
             else:
@@ -479,14 +557,44 @@ class Executor:
     def _exec_op(self, ctx: LoweringContext, op, env: Dict[str, Any]):
         if op.type in ("feed", "fetch"):
             return
-        opdef = registry.get(op.type)
-        assert opdef.lower is not None, f"op '{op.type}' has no lowering"
+        try:
+            opdef = registry.get(op.type)
+        except KeyError as e:
+            raise RuntimeError(
+                f"Operator '{op.type}' is not registered "
+                f"(outputs {op.output_arg_names}); available ops: "
+                f"{len(registry.registered_ops())} registered") from e
+        if opdef.lower is None:
+            raise RuntimeError(
+                f"Operator '{op.type}' has no kernel lowering "
+                f"(inputs {dict(op.desc.inputs)}, "
+                f"outputs {dict(op.desc.outputs)})")
         prev_env = ctx.env
         ctx.env = env
         ctx.seq_overrides = {}
         ins = {slot: [env.get(n) for n in names]
                for slot, names in op.desc.inputs.items()}
-        outs = opdef.lower(ctx, op, ins)
+        if op.type not in _SPARSE_AWARE_OPS:
+            # SelectedRows grads (sparse embedding path) densify at the
+            # boundary of any op without a sparse kernel — the analogue of
+            # the reference's per-kernel SelectedRows dispatch
+            from .ops.common import SelectedRowsVal
+            ins = {slot: [v.to_dense() if isinstance(v, SelectedRowsVal)
+                          else v for v in vals]
+                   for slot, vals in ins.items()}
+        try:
+            outs = opdef.lower(ctx, op, ins)
+        except (AssertionError, TypeError, ValueError, IndexError) as e:
+            # PADDLE_ENFORCE-style context (reference platform/enforce.h):
+            # name the failing operator and its variables, with the live
+            # input shapes, instead of a bare JAX traceback
+            shapes = {slot: [getattr(v, "shape", None) for v in vals]
+                      for slot, vals in ins.items()}
+            raise RuntimeError(
+                f"Operator {op.type} failed: {e}\n"
+                f"  inputs: {dict(op.desc.inputs)}\n"
+                f"  input shapes: {shapes}\n"
+                f"  outputs: {dict(op.desc.outputs)}") from e
         # Default SEQLEN propagation mirrors the reference's LoD propagation
         # (most ops share LoD with their first sequence input); sequence
         # lowerings override via ctx.set_seq_len. Inheritance is restricted
@@ -495,11 +603,13 @@ class Executor:
         # collapses) is no longer a sequence, and tagging it would make the
         # fetch path spuriously repack a dense tensor.
         inherited = None
+        inherited2 = None
         carrier_shape = None
         for names in op.desc.inputs.values():
             for n in names:
                 if n + SEQLEN_SUFFIX in env:
                     inherited = env[n + SEQLEN_SUFFIX]
+                    inherited2 = env.get(n + SEQLEN2_SUFFIX)
                     carrier_shape = getattr(env.get(n), "shape", None)
                     break
             if inherited is not None:
@@ -509,6 +619,12 @@ class Executor:
             for name, val in zip(names, vals):
                 if val is not None:
                     env[name] = val
+                    if name + SEQLEN2_SUFFIX in ctx.seq_overrides:
+                        sl2 = ctx.seq_overrides[name + SEQLEN2_SUFFIX]
+                        if sl2 is None:
+                            env.pop(name + SEQLEN2_SUFFIX, None)
+                        else:
+                            env[name + SEQLEN2_SUFFIX] = sl2
                     if name in ctx.seq_overrides:
                         sl = ctx.seq_overrides[name]
                         if sl is None:
@@ -521,6 +637,9 @@ class Executor:
                             and len(carrier_shape) >= 2 \
                             and tuple(val.shape[:2]) == tuple(carrier_shape[:2]):
                         env[name + SEQLEN_SUFFIX] = inherited
+                        if inherited2 is not None and \
+                                name + SEQLEN2_SUFFIX not in ctx.seq_overrides:
+                            env[name + SEQLEN2_SUFFIX] = inherited2
         ctx.env = prev_env
 
     def _trace_block(self, program, feed_vals, state_vals, fetch_names,
@@ -532,11 +651,15 @@ class Executor:
         block = program.global_block()
         for op in block.ops:
             self._exec_op(ctx, op, env)
-        fetch = [env[n] for n in fetch_names]
+        from .ops.common import maybe_dense
+        fetch = [maybe_dense(env[n]) for n in fetch_names]
         # lengths side channel for fetched sequence vars, so run() can
         # rebuild LoDTensors (padded_to_pack) when return_numpy=False
         fetch_lens = {n: env[n + SEQLEN_SUFFIX] for n in fetch_names
                       if n + SEQLEN_SUFFIX in env}
+        for n in fetch_names:
+            if n + SEQLEN2_SUFFIX in env:
+                fetch_lens[n + SEQLEN2_SUFFIX] = env[n + SEQLEN2_SUFFIX]
         new_state = {n: env[n] for n in persist_out if n in env}
         # state read but never written flows through unchanged
         for n in state_vals:
@@ -556,6 +679,10 @@ class Executor:
                 if b.desc.has_var(n):
                     if b.desc.var(n).lod_level > 0:
                         new_state[n + SEQLEN_SUFFIX] = env[n + SEQLEN_SUFFIX]
+                        if n + SEQLEN2_SUFFIX in env and \
+                                b.desc.var(n).lod_level > 1:
+                            new_state[n + SEQLEN2_SUFFIX] = \
+                                env[n + SEQLEN2_SUFFIX]
                     break
         return fetch, fetch_lens, new_state
 
@@ -604,9 +731,13 @@ class Executor:
                         if not bool(jnp.all(jnp.isfinite(v))):
                             raise FloatingPointError(
                                 f"NaN/Inf in output '{name}' of op {op.type}")
-        fetch = [env[n] for n in fetch_names]
+        from .ops.common import maybe_dense
+        fetch = [maybe_dense(env[n]) for n in fetch_names]
         fetch_lens = {n: env[n + SEQLEN_SUFFIX] for n in fetch_names
                       if n + SEQLEN_SUFFIX in env}
+        for n in fetch_names:
+            if n + SEQLEN2_SUFFIX in env:
+                fetch_lens[n + SEQLEN2_SUFFIX] = env[n + SEQLEN2_SUFFIX]
         new_state = {}
         for n in set(persist_out) | set(state_vals):
             if n.endswith(SEQLEN_SUFFIX):
@@ -623,5 +754,9 @@ class Executor:
                 if b.desc.has_var(n):
                     if b.desc.var(n).lod_level > 0:
                         new_state[n + SEQLEN_SUFFIX] = env[n + SEQLEN_SUFFIX]
+                        if n + SEQLEN2_SUFFIX in env and \
+                                b.desc.var(n).lod_level > 1:
+                            new_state[n + SEQLEN2_SUFFIX] = \
+                                env[n + SEQLEN2_SUFFIX]
                     break
         return fetch, fetch_lens, new_state

@@ -17,7 +17,8 @@ from typing import Any, Dict, Iterable, List, Optional
 import numpy as np
 
 from . import unique_name
-from .desc import BlockDesc, BlockRef, OpDesc, ProgramDesc, VarDesc, VarType
+from .desc import (BlockDesc, BlockRef, BlocksRef, OpDesc, ProgramDesc,
+                   VarDesc, VarType)
 
 __all__ = [
     "Variable",
@@ -391,6 +392,13 @@ class Program:
         p = Program()
         p.desc = ProgramDesc.from_json(self.desc.to_json())
         p._seed = self._seed
+        # dynamic execution attributes ride along (the reference keeps these
+        # in the desc; here they are Python-side program state): mesh tag,
+        # AMP policy, bound reader pipelines
+        p._mesh = getattr(self, "_mesh", None)
+        for attr in ("_amp_dtype", "_amp_level", "_pipeline_readers"):
+            if hasattr(self, attr):
+                setattr(p, attr, getattr(self, attr))
         p.blocks = [Block(p, i) for i in range(len(p.desc.blocks))]
         for b in p.blocks:
             b._sync_ops()
@@ -423,22 +431,54 @@ class Program:
         """
         pruned = self.clone()
         block = pruned.global_block()
+
+        def op_reads(op):
+            """Inputs of an op including everything its sub-blocks read from
+            the outside (reference prune.cc:181 recurses into block attrs —
+            a while/conditional_block keeps its upstream producers)."""
+            reads = set(op.input_arg_names)
+            sub_idxs = []
+            for a in op.desc.attrs.values():
+                if isinstance(a, BlockRef):
+                    sub_idxs.append(a.idx)
+                elif isinstance(a, BlocksRef):
+                    sub_idxs.extend(a.idxs)
+            seen = set()
+            while sub_idxs:
+                si = sub_idxs.pop()
+                if si in seen:
+                    continue
+                seen.add(si)
+                sub = pruned.block(si)
+                produced = set()
+                for sop in sub.ops:
+                    for name in sop.input_arg_names:
+                        if name not in produced and not sub.desc.has_var(name):
+                            reads.add(name)
+                    produced.update(sop.output_arg_names)
+                    for a in sop.desc.attrs.values():
+                        if isinstance(a, BlockRef):
+                            sub_idxs.append(a.idx)
+                        elif isinstance(a, BlocksRef):
+                            sub_idxs.extend(a.idxs)
+            return reads
+
         needed = set(fetches)
         keep: List[int] = []
         for i in range(len(block.ops) - 1, -1, -1):
             op = block.ops[i]
             if needed & set(op.output_arg_names):
                 keep.append(i)
-                for name in op.input_arg_names:
+                for name in op_reads(op):
                     if name not in feeds:
                         needed.add(name)
         keep.reverse()
         block.desc.ops = [block.desc.ops[i] for i in keep]
         block._sync_ops()
-        # drop vars no longer referenced
+        # drop root vars no longer referenced (sub-block vars stay put)
         used = set(feeds) | set(fetches)
         for op in block.ops:
-            used |= set(op.input_arg_names) | set(op.output_arg_names)
+            used |= op_reads(op) | set(op.output_arg_names)
         for name in list(block.desc.vars):
             if name not in used:
                 del block.desc.vars[name]

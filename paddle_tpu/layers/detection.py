@@ -13,8 +13,11 @@ from __future__ import annotations
 
 import numpy as np
 
+import math
+
 from ..framework.framework import Variable
 from ..layer_helper import LayerHelper
+from . import control_flow as cf_layers
 from . import nn
 from . import ops as ops_layers
 from . import tensor as tensor_layers
@@ -224,11 +227,11 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     conf_loss = nn.elementwise_mul(conf_loss, conf_weight)
 
     diff = nn.elementwise_sub(location, loc_target)
-    abs_diff = _abs(helper, diff)
+    abs_diff = ops_layers.abs(diff)
     one = tensor_layers.fill_constant(shape=[1], dtype="float32", value=1.0)
     sq = nn.scale(nn.elementwise_mul(diff, diff), scale=0.5)
     lin = nn.scale(abs_diff, scale=1.0, bias=-0.5)
-    is_small = nn.cast(_less_than(helper, abs_diff, one), "float32")
+    is_small = nn.cast(cf_layers.less_than(abs_diff, one), "float32")
     is_big = nn.scale(is_small, scale=-1.0, bias=1.0)
     l1 = nn.elementwise_add(nn.elementwise_mul(sq, is_small),
                             nn.elementwise_mul(lin, is_big))
@@ -247,20 +250,6 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     return loss
 
 
-def _abs(helper, x):
-    out = helper.create_tmp_variable(x.dtype)
-    helper.append_op(type="abs", inputs={"X": [x]}, outputs={"Out": [out]},
-                     attrs={})
-    return out
-
-
-def _less_than(helper, x, y):
-    out = helper.create_tmp_variable("bool")
-    helper.append_op(type="less_than", inputs={"X": [x], "Y": [y]},
-                     outputs={"Out": [out]}, attrs={})
-    return out
-
-
 def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                    min_ratio=None, max_ratio=None, min_sizes=None,
                    max_sizes=None, steps=None, step_w=None, step_h=None,
@@ -275,7 +264,7 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         num_layer = len(inputs)
         min_sizes, max_sizes = [], []
         if num_layer > 2:
-            step = int(math_floor((max_ratio - min_ratio) / (num_layer - 2)))
+            step = int(math.floor((max_ratio - min_ratio) / (num_layer - 2)))
             for ratio in range(min_ratio, max_ratio + 1, step):
                 min_sizes.append(base_size * ratio / 100.0)
                 max_sizes.append(base_size * (ratio + step) / 100.0)
@@ -325,8 +314,3 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     boxes = tensor_layers.concat(prior_list, axis=0)
     variances = tensor_layers.concat(var_list, axis=0)
     return mbox_loc, mbox_conf, boxes, variances
-
-
-def math_floor(x):
-    import math
-    return math.floor(x)

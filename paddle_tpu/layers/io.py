@@ -1,11 +1,30 @@
-"""Data-input layers (reference: python/paddle/fluid/layers/io.py:29 data)."""
+"""Data-input layers: feed declarations and file readers
+(reference: python/paddle/fluid/layers/io.py:29 data, :261 open_recordio_file,
+:290 read_file, :334 shuffle, :347 double_buffer(batch)).
+
+The reference builds readers as graph ops (ReaderHolder variables chained
+through decorated-reader ops, framework/reader.h:28-68) executed by the
+C++ executor. Here the reader chain is a host-side pipeline bound to the
+program: `read_file` declares the data variables and registers the pipeline,
+and `Executor.run` pulls the next batch from it when no explicit feed is
+given — same user code shape (`while True: exe.run()` until EOF), with the
+double-buffer stage doing the host->HBM prefetch overlap that the
+reference's double_buffer reader op does."""
 
 from __future__ import annotations
+
+import numpy as np
 
 from ..framework.desc import VarType
 from ..framework.framework import default_main_program, default_startup_program
 
-__all__ = ["data"]
+__all__ = ["data", "open_recordio_file", "read_file", "shuffle", "batch",
+           "double_buffer", "EOFException"]
+
+
+class EOFException(Exception):
+    """Raised by Executor.run when a program-bound reader is exhausted
+    (reference: fluid.core.EOFException from reader ops)."""
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -25,5 +44,162 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
                                   type=type, lod_level=lod_level,
                                   stop_gradient=stop_gradient)
     var.desc.stop_gradient = stop_gradient
-    # mirror in startup program so save/load sees consistent descs
     return var
+
+
+class FileReader:
+    """Host-side reader pipeline handle (the ReaderHolder equivalent,
+    reference framework/reader.h:28). `source` yields per-sample tuples of
+    arrays; decorators rebind `source`; `read_file` attaches the finished
+    chain to the program."""
+
+    def __init__(self, source, dtypes, shapes=None, lod_levels=None):
+        self.source = source            # callable -> iterable of tuples
+        self.dtypes = list(dtypes)
+        self.shapes = list(shapes) if shapes else None
+        self.lod_levels = list(lod_levels or [0] * len(self.dtypes))
+        self.batched = False
+        self.buffered = False           # double_buffer applied
+        self._iter = None
+
+    def reset(self):
+        self._iter = None
+
+    def _start(self, device):
+        it = self.source()
+        if self.buffered:
+            from ..reader.pipeline import DoubleBufferedFeeder
+            import jax
+
+            def to_feed(t):
+                # the producer thread stages plain arrays in device memory
+                # ahead of consumption (the double_buffer decorator's H2D
+                # overlap); LoDTensors stay host-side — the executor must
+                # pack them before upload
+                if device is not None:
+                    t = tuple(
+                        jax.device_put(v, device)
+                        if isinstance(v, (np.ndarray, np.generic)) else v
+                        for v in t)
+                return {"__tuple__": t}
+
+            dbf = DoubleBufferedFeeder(
+                lambda: self.source(), to_feed=to_feed, device=None)
+            it = (d["__tuple__"] for d in dbf)
+        self._iter = iter(it)
+
+    def next_batch(self, device=None):
+        if self._iter is None:
+            self._start(device)
+        try:
+            return next(self._iter)
+        except StopIteration:
+            self._iter = None
+            raise EOFException("reader exhausted; call reader.reset()")
+
+
+def open_recordio_file(filename, shapes, lod_levels, dtypes,
+                       pass_num=1, for_parallel=False):
+    """Reader over a RecordIO file of pickled sample tuples (reference
+    io.py:261 + operators/reader/create_recordio_file_reader_op.cc)."""
+    from .. import recordio as recordio_mod
+
+    def source():
+        for _ in range(max(pass_num, 1)):
+            for sample in recordio_mod.read_samples(filename):
+                yield tuple(np.asarray(a) for a in sample)
+
+    return FileReader(source, dtypes, shapes, lod_levels)
+
+
+def shuffle(reader, buffer_size):
+    """Buffered shuffle decorator (reference io.py:334,
+    create_shuffle_reader_op.cc)."""
+    import random
+    inner = reader.source
+
+    def source():
+        buf = []
+        for s in inner():
+            buf.append(s)
+            if len(buf) >= buffer_size:
+                random.shuffle(buf)
+                while buf:
+                    yield buf.pop()
+        random.shuffle(buf)
+        while buf:
+            yield buf.pop()
+
+    reader.source = source
+    return reader
+
+
+def batch(reader, batch_size):
+    """Batch samples into stacked arrays / packed LoD rows (reference
+    create_batch_reader_op.cc). Variable-length slots (lod_level>0) come
+    out as LoDTensors in the padded-feed convention."""
+    from ..executor import LoDTensor
+    inner = reader.source
+    lod_levels = reader.lod_levels
+
+    def make_batch(samples):
+        out = []
+        for i in range(len(samples[0])):
+            rows = [s[i] for s in samples]
+            if lod_levels[i] and lod_levels[i] > 0:
+                flat = np.concatenate(rows, axis=0)
+                offs = [0]
+                for r in rows:
+                    offs.append(offs[-1] + len(r))
+                out.append(LoDTensor(flat, [offs]))
+            else:
+                out.append(np.stack(rows))
+        return tuple(out)
+
+    def source():
+        chunk = []
+        for s in inner():
+            chunk.append(s)
+            if len(chunk) == batch_size:
+                yield make_batch(chunk)
+                chunk = []
+        if chunk:
+            yield make_batch(chunk)
+
+    reader.source = source
+    reader.batched = True
+    return reader
+
+
+def double_buffer(reader, place=None, name=None):
+    """Prefetch decorator (reference io.py:347,
+    create_double_buffer_reader_op.cc): a producer thread stages the next
+    batch while the current one computes."""
+    reader.buffered = True
+    return reader
+
+
+def read_file(reader):
+    """Bind the reader chain to the program and declare its output data
+    variables (reference io.py:290 read_file + ReaderHolder). Executor.run
+    with no feed pulls batches from here."""
+    assert reader.batched, "apply fluid.layers.batch(reader, N) before read_file"
+    prog = default_main_program()
+    block = prog.current_block()
+    out_vars = []
+    n = len(reader.dtypes)
+    for i in range(n):
+        shape = list(reader.shapes[i]) if reader.shapes else [-1]
+        lod = reader.lod_levels[i]
+        name = f"_reader_out_{len(getattr(prog, '_pipeline_readers', []))}_{i}"
+        if lod and lod > 0:
+            shape = [-1] * lod + [s for s in shape if s != -1]
+        var = block.create_var(name=name, shape=shape,
+                               dtype=reader.dtypes[i], lod_level=lod,
+                               stop_gradient=True)
+        var.desc.stop_gradient = True
+        out_vars.append(var)
+    if not hasattr(prog, "_pipeline_readers"):
+        prog._pipeline_readers = []
+    prog._pipeline_readers.append((reader, [v.name for v in out_vars]))
+    return out_vars if len(out_vars) > 1 else out_vars[0]

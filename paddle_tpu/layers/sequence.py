@@ -12,6 +12,7 @@ from ..layer_helper import LayerHelper
 from ..initializer import ConstantInitializer
 
 __all__ = [
+    "sequence_unfold", "sequence_fold",
     "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit", "lstm_unit",
     "sequence_conv", "sequence_pool", "sequence_first_step",
     "sequence_last_step", "sequence_softmax", "sequence_expand",
@@ -267,4 +268,26 @@ def lod_reset(x, y=None, target_lod=None):
                          attrs={"target_lod": list(target_lod)})
     else:
         raise ValueError("lod_reset needs y or target_lod")
+    return out
+
+
+def sequence_unfold(x):
+    """Flatten a nested (lod_level=2) sequence batch [B, S, T, ...] into its
+    sub-sequences [B*S, T, ...] so inner-level sequence ops apply (TPU-native
+    nested-LoD idiom; reference nested offsets lod_tensor.h:55)."""
+    helper = LayerHelper("sequence_unfold")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="sequence_unfold", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_fold(x, outer_like):
+    """Regroup flattened sub-sequences back to [B, S, ...] using the outer
+    structure of `outer_like` (the var sequence_unfold was applied to)."""
+    helper = LayerHelper("sequence_fold")
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="sequence_fold",
+                     inputs={"X": [x], "OuterLike": [outer_like]},
+                     outputs={"Out": [out]}, attrs={})
     return out

@@ -2,10 +2,39 @@
 
 from __future__ import annotations
 
-from typing import List, Optional
+from dataclasses import dataclass
+from typing import Any, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+@dataclass
+class SelectedRowsVal:
+    """Sparse-rows gradient value: the TPU-native SelectedRows
+    (reference: framework/selected_rows.h:19). `rows` may repeat (like the
+    reference's unmerged SelectedRows); consumers either scatter-add
+    (sparse optimizer update touching only K rows of the table) or
+    densify. Static `height` is the dense row count of the full table."""
+    rows: Any          # int32 [K]
+    values: Any        # [K, D...]
+    height: int
+
+    def to_dense(self):
+        dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+
+jax.tree_util.register_pytree_node(
+    SelectedRowsVal,
+    lambda v: ((v.rows, v.values), v.height),
+    lambda h, ch: SelectedRowsVal(ch[0], ch[1], h))
+
+
+def maybe_dense(v):
+    return v.to_dense() if isinstance(v, SelectedRowsVal) else v
 
 
 def to_np_dtype(name: str):

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .registry import NO_GRAD, op, register
-from .common import (broadcast_y_to_x, in_var, matmul_shape, mxu_cast, out_var,
+from .common import (SelectedRowsVal, maybe_dense, broadcast_y_to_x, in_var, matmul_shape, mxu_cast, out_var,
                      same_as_input, set_out)
 
 
@@ -284,7 +284,17 @@ def _sum_infer(op_, block):
 
 @op("sum", infer_shape=_sum_infer)
 def _sum(ctx, op_, ins):
-    xs = [jnp.asarray(x) for x in ins["X"] if x is not None]
+    """Element sum with SelectedRows support (reference sum_op.cc handles
+    dense+sparse mixes): all-sparse inputs concatenate rows/values (rows may
+    repeat, like the reference's unmerged SelectedRows), a mix densifies."""
+    raw = [x for x in ins["X"] if x is not None]
+    if raw and all(isinstance(x, SelectedRowsVal) for x in raw):
+        if len(raw) == 1:
+            return {"Out": [raw[0]]}
+        rows = jnp.concatenate([x.rows for x in raw])
+        vals = jnp.concatenate([x.values for x in raw])
+        return {"Out": [SelectedRowsVal(rows, vals, raw[0].height)]}
+    xs = [jnp.asarray(maybe_dense(x)) for x in raw]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

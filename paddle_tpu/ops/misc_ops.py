@@ -243,20 +243,24 @@ def _load(ctx, op_, ins):
 def _load_combine(ctx, op_, ins):
     path = op_.attr("file_path")
     names = op_.desc.outputs["Out"]
-    outs = []
+    specs = []
     for i, name in enumerate(names):
         shape, dtype = _out_shape_dtype(op_, "Out", i)
         assert shape is not None, (
             f"load_combine: var '{name}' needs a static shape/dtype")
+        specs.append(jax.ShapeDtypeStruct(shape, dtype))
 
-        def cb(name=name, shape=shape, dtype=dtype):
-            with open(path, "rb") as f:
-                d = pickle.load(f)
-            arr, _ = d[name]
-            return np.asarray(arr, dtype=dtype).reshape(shape)
+    def cb():
+        # one read + unpickle for all outputs (reference load_combine_op.cc
+        # reads the stream once)
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+        return tuple(
+            np.asarray(d[name][0], dtype=spec.dtype).reshape(spec.shape)
+            for name, spec in zip(names, specs))
 
-        outs.append(jax.pure_callback(cb, jax.ShapeDtypeStruct(shape, dtype)))
-    return {"Out": outs}
+    outs = jax.pure_callback(cb, tuple(specs))
+    return {"Out": list(outs)}
 
 
 # --- LoD-array ops --------------------------------------------------------------

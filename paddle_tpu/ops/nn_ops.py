@@ -18,8 +18,8 @@ import numpy as np
 from ..framework.desc import OpDesc
 from ..framework.framework import grad_var_name
 from .registry import NO_GRAD, op, register
-from .common import (in_var, mxu_cast, out_var, same_as_input, set_out,
-                     to_np_dtype)
+from .common import (SelectedRowsVal, in_var, mxu_cast, out_var,
+                     same_as_input, set_out, to_np_dtype)
 
 
 # --- softmax ----------------------------------------------------------------
@@ -197,6 +197,30 @@ def _lookup_table(ctx, op_, ins):
     if pad is not None and pad >= 0:
         out = jnp.where((ids == pad)[..., None], 0.0, out)
     return {"Out": [out]}
+
+
+@op("lookup_table_grad", grad=NO_GRAD)
+def _lookup_table_grad(ctx, op_, ins):
+    """Embedding gradient (reference lookup_table_op.cc LookupTableGradKernel).
+    is_sparse=True returns a SelectedRowsVal — ids + per-lookup cotangent
+    rows, duplicates unmerged exactly like the reference — so the sgd
+    update is a scatter-add touching only the looked-up rows instead of a
+    dense table-sized gradient (reference selected_rows_functor.cc).
+    Dense path scatter-adds into a full zeros table."""
+    w = jnp.asarray(ins["W"][0])
+    ids = jnp.asarray(ins["Ids"][0])
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    g = jnp.asarray(ins["Out@GRAD"][0])
+    pad = op_.attr("padding_idx", -1)
+    flat_ids = ids.reshape(-1).astype(jnp.int32)
+    flat_g = g.reshape(-1, g.shape[-1]).astype(w.dtype)
+    if pad is not None and pad >= 0:
+        flat_g = jnp.where((flat_ids == pad)[:, None], 0.0, flat_g)
+    if op_.attr("is_sparse", False):
+        return {"W@GRAD": [SelectedRowsVal(flat_ids, flat_g, w.shape[0])]}
+    dense = jnp.zeros_like(w).at[flat_ids].add(flat_g)
+    return {"W@GRAD": [dense]}
 
 
 # --- conv / pool ------------------------------------------------------------

@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .registry import NO_GRAD, op
-from .common import in_var, set_out
+from .common import SelectedRowsVal, maybe_dense, in_var, set_out
 
 
 def _param_out_infer(*pairs):
@@ -33,14 +33,22 @@ def _param_grad(ins):
     """(param, grad) with the grad upcast to the param dtype: fp32
     master-weight updates under AMP O2 receive bf16 grads, which must be
     upcast before any arithmetic so lr*g and accumulators stay full
-    precision."""
+    precision. SelectedRows grads densify here; sgd has its own sparse
+    fast path (reference: only sgd/adam register SelectedRows kernels)."""
     p = jnp.asarray(ins["Param"][0])
-    return p, jnp.asarray(ins["Grad"][0]).astype(p.dtype)
+    return p, jnp.asarray(maybe_dense(ins["Grad"][0])).astype(p.dtype)
 
 
 
 @op("sgd", grad=NO_GRAD, infer_shape=_param_out_infer(("Param", "ParamOut")))
 def _sgd(ctx, op_, ins):
+    g0 = ins["Grad"][0]
+    if isinstance(g0, SelectedRowsVal):
+        # sparse update: scatter-add only the looked-up rows (reference
+        # sgd_op.h SelectedRows branch / selected_rows_functor.cc)
+        p = jnp.asarray(ins["Param"][0])
+        upd = (-_lr(ins) * g0.values).astype(p.dtype)
+        return {"ParamOut": [p.at[g0.rows].add(upd)]}
     p, g = _param_grad(ins)
     return {"ParamOut": [p - _lr(ins) * g]}
 

@@ -567,3 +567,74 @@ def _lod_reset(ctx, op_, ins):
     for name in op_.desc.outputs.get("Out", []):
         ctx.set_seq_len(name, lengths)
     return {"Out": [x]}
+
+
+# ---------------------------------------------------------------------------
+# Nested (level-2) LoD plumbing
+# ---------------------------------------------------------------------------
+
+def _unfold_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None and xv.shape is not None and len(xv.shape) >= 2:
+        b, sdim = xv.shape[0], xv.shape[1]
+        lead = None if (b is None or b < 0 or sdim is None or sdim < 0) \
+            else b * sdim
+        set_out(op_, block, "Out", [lead if lead is not None else -1]
+                + list(xv.shape[2:]), xv.dtype)
+
+
+@op("sequence_unfold", grad=None, infer_shape=_unfold_infer)
+def _sequence_unfold(ctx, op_, ins):
+    """Nested batch [B, S, T, ...] -> flat sub-sequence batch [B*S, T, ...]
+    whose @SEQLEN is the flattened inner lengths (0 for padded sub-slots).
+    The TPU-native entry to level-2 LoD (reference lod_tensor.h:55 nested
+    offsets, RecurrentGradientMachine.h:32 nested step semantics): inner
+    sequence ops then run masked over the flattened batch, and
+    sequence_fold restores the outer grouping."""
+    x = jnp.asarray(ins["X"][0])
+    b, s = x.shape[0], x.shape[1]
+    name = op_.desc.inputs["X"][0]
+    inner = ctx.seq_len2(name)
+    outer = ctx.seq_len(name)
+    if inner is None:
+        inner = jnp.full((b, s), x.shape[2], jnp.int32)
+        if outer is not None:
+            inner = jnp.where(
+                jnp.arange(s)[None, :] < jnp.asarray(outer)[:, None],
+                inner, 0)
+    out = x.reshape((b * s,) + tuple(x.shape[2:]))
+    out_name = op_.desc.outputs["Out"][0]
+    ctx.set_seq_len(out_name, jnp.asarray(inner).reshape(-1))
+    ctx.set_seq_len2(out_name, None)
+    return {"Out": [out]}
+
+
+def _fold_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    lv = in_var(op_, block, "OuterLike")
+    if xv is not None and xv.shape is not None and lv is not None \
+            and lv.shape is not None and len(lv.shape) >= 2:
+        set_out(op_, block, "Out",
+                [lv.shape[0], lv.shape[1]] + list(xv.shape[1:]), xv.dtype)
+
+
+@op("sequence_fold", grad=None, non_diff_inputs=("OuterLike",),
+    infer_shape=_fold_infer)
+def _sequence_fold(ctx, op_, ins):
+    """Inverse of sequence_unfold: [B*S, ...] -> [B, S, ...], restoring the
+    outer lengths channel from OuterLike (the original nested var)."""
+    x = jnp.asarray(ins["X"][0])
+    like_name = op_.desc.inputs["OuterLike"][0]
+    like = jnp.asarray(ins["OuterLike"][0])
+    b, s = like.shape[0], like.shape[1]
+    out = x.reshape((b, s) + tuple(x.shape[1:]))
+    out_name = op_.desc.outputs["Out"][0]
+    ctx.set_seq_len(out_name, ctx.seq_len(like_name))
+    inner = None
+    # inner lengths only survive if the folded payload still has a time axis
+    if out.ndim >= 3 and ctx.seq_len2(like_name) is not None:
+        il = jnp.asarray(ctx.seq_len2(like_name))
+        if out.shape[2] == jnp.asarray(ins["OuterLike"][0]).shape[2]:
+            inner = il
+    ctx.set_seq_len2(out_name, inner)
+    return {"Out": [out]}

@@ -6,6 +6,7 @@
 from __future__ import annotations
 
 from collections import defaultdict
+from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 from .backward import append_backward
@@ -23,7 +24,7 @@ __all__ = [
     "Adadelta", "RMSProp", "Ftrl", "SGDOptimizer", "MomentumOptimizer",
     "AdagradOptimizer", "AdamOptimizer", "AdamaxOptimizer",
     "DecayedAdagradOptimizer", "AdadeltaOptimizer", "RMSPropOptimizer",
-    "FtrlOptimizer", "Optimizer",
+    "FtrlOptimizer", "Optimizer", "ModelAverage",
 ]
 
 
@@ -411,3 +412,106 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+
+
+class ModelAverage(Optimizer):
+    """Maintain running parameter averages and swap them in for evaluation
+    (reference optimizer.py:811 ModelAverage, average_accumulates_op.cc).
+
+    Appends an average_accumulates op per parameter to the main program;
+    `apply()` is a context manager that replaces each parameter with
+    (sum_1 + sum_2 + sum_3) / (num_accumulates + old_num_accumulates) and
+    restores the trained values on exit (or via `restore()`)."""
+
+    def __init__(self, average_window_rate, params_grads=None,
+                 min_average_window=10000, max_average_window=10000,
+                 **kwargs):
+        super().__init__(0.0, **kwargs)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self.params_grads = params_grads or [
+            (p, None) for p in
+            default_main_program().global_block().all_parameters()]
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._avg_params = []
+        for param, _ in self.params_grads:
+            self._append_average_accumulate_op(param)
+            self._avg_params.append(param)
+
+    def _append_average_accumulate_op(self, param):
+        sum_1 = self._add_accumulator("sum_1", param)
+        sum_2 = self._add_accumulator("sum_2", param)
+        sum_3 = self._add_accumulator("sum_3", param)
+        num_acc = self._add_accumulator("num_accumulates", param,
+                                        dtype="int32", shape=[1])
+        old_num = self._add_accumulator("old_num_accumulates", param,
+                                        dtype="int32", shape=[1])
+        num_upd = self._add_accumulator("num_updates", param,
+                                        dtype="int32", shape=[1])
+        default_main_program().global_block().append_op(
+            type="average_accumulates",
+            inputs={"param": [param], "in_sum_1": [sum_1],
+                    "in_sum_2": [sum_2], "in_sum_3": [sum_3],
+                    "in_num_accumulates": [num_acc],
+                    "in_old_num_accumulates": [old_num],
+                    "in_num_updates": [num_upd]},
+            outputs={"out_sum_1": [sum_1], "out_sum_2": [sum_2],
+                     "out_sum_3": [sum_3],
+                     "out_num_accumulates": [num_acc],
+                     "out_old_num_accumulates": [old_num],
+                     "out_num_updates": [num_upd]},
+            attrs={"average_window": float(self.average_window),
+                   "min_average_window": int(self.min_average_window),
+                   "max_average_window": int(self.max_average_window)})
+
+    def _swap_program(self, restore):
+        from .framework.framework import Program, program_guard
+        from .layers import tensor as tl
+        from .layers import nn as nl
+        prog = Program()
+        with program_guard(prog, Program()):
+            for param, _ in self.params_grads:
+                block = prog.global_block()
+                p = block.create_var(name=param.name, shape=param.shape,
+                                     dtype=param.dtype, persistable=True)
+                backup = block.create_var(
+                    name=param.name + "@MODEL_AVG_BACKUP",
+                    shape=param.shape, dtype=param.dtype, persistable=True)
+                if restore:
+                    tl.assign(backup, output=p)
+                    continue
+                s1 = self._ref(block, self._get_accumulator("sum_1", param))
+                s2 = self._ref(block, self._get_accumulator("sum_2", param))
+                s3 = self._ref(block, self._get_accumulator("sum_3", param))
+                na = self._ref(block,
+                               self._get_accumulator("num_accumulates", param))
+                on = self._ref(block, self._get_accumulator(
+                    "old_num_accumulates", param))
+                tl.assign(p, output=backup)
+                total = nl.elementwise_add(nl.elementwise_add(s1, s2), s3)
+                cnt = tl.cast(nl.elementwise_add(na, on), "float32")
+                cnt = nl.elementwise_max(
+                    cnt, tl.fill_constant(shape=[1], dtype="float32",
+                                          value=1.0))
+                avg = nl.elementwise_div(total, cnt, axis=0)
+                tl.assign(avg, output=p)
+        return prog
+
+    @staticmethod
+    def _ref(block, var):
+        return block.create_var(name=var.name, shape=var.shape,
+                                dtype=var.dtype, persistable=True)
+
+    @contextmanager
+    def apply(self, executor, need_restore=True):
+        """Swap averaged parameter values in (reference optimizer.py:885)."""
+        executor.run(self._swap_program(restore=False))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        executor.run(self._swap_program(restore=True))

@@ -4,3 +4,4 @@ NCCL / parallel_do stack (SURVEY.md §2.5). See `mesh.py` and `transpiler.py`.""
 from . import mesh
 from .mesh import get_mesh, set_mesh, data_parallel_mesh
 from . import transpiler
+from . import multihost

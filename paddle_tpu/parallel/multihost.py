@@ -1,0 +1,117 @@
+"""Multi-host bring-up + fault-tolerant data sharding + checkpoint-restart.
+
+TPU-native replacement for the reference's distributed control plane
+(reference: go/master/service.go:89-455 — the Go master partitions recordio
+chunks into a task queue so any number of trainers can consume them, with
+snapshot/recover; go/pserver/service.go:120-227,346 — parameter shards
+checkpointed with metadata for restart; trainer env plumbing
+python/paddle/fluid/tests/book/test_fit_a_line.py:71-96 PADDLE_INIT_*).
+
+On TPU the data plane is jax.distributed + GSPMD: every host runs the same
+SPMD program, `initialize()` wires the processes into one JAX runtime
+(collectives ride ICI/DCN; no pservers), `shard_reader` statically
+partitions the sample stream by process the way the master's task queue
+does dynamically (elastic trainer counts are descoped — see README), and
+save/load_checkpoint give the kill-and-resume loop: persistables + a
+step-counter metadata file, written atomically, recovered on restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Callable, Iterable, Optional
+
+__all__ = ["initialize", "shard_reader", "save_checkpoint",
+           "load_checkpoint", "latest_checkpoint"]
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """Bring up the multi-controller JAX runtime (one process per host).
+
+    Reads the reference's env conventions when args are omitted:
+    PADDLE_COORDINATOR (host:port of process 0), PADDLE_TRAINERS,
+    PADDLE_TRAINER_ID (reference trainer env: test_fit_a_line.py:83-90).
+    No-op in single-process mode (nothing to coordinate)."""
+    import jax
+    coordinator_address = coordinator_address or \
+        os.environ.get("PADDLE_COORDINATOR")
+    num_processes = num_processes if num_processes is not None else \
+        int(os.environ.get("PADDLE_TRAINERS", "1"))
+    process_id = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if num_processes <= 1 or coordinator_address is None:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def shard_reader(reader: Callable[[], Iterable], num_shards=None,
+                 shard_id=None):
+    """Partition a sample stream across processes: process i consumes every
+    num_shards-th sample starting at i. The static-sharding equivalent of
+    the Go master's chunk task queue (go/master/service.go:106 partition) —
+    every host sees a disjoint 1/N of the data each pass."""
+    import jax
+    if num_shards is None:
+        num_shards = jax.process_count()
+    if shard_id is None:
+        shard_id = jax.process_index()
+
+    def sharded():
+        for i, sample in enumerate(reader()):
+            if i % num_shards == shard_id:
+                yield sample
+
+    return sharded
+
+
+# --- checkpoint-restart -------------------------------------------------------
+
+_META = "checkpoint_meta.json"
+
+
+def save_checkpoint(executor, dirname: str, step: int, main_program=None,
+                    extra_meta: Optional[dict] = None):
+    """Persistables + step metadata, written atomically (temp file + rename)
+    so a crash mid-write never corrupts the latest checkpoint — the
+    md5+meta discipline of the Go pserver checkpoints
+    (go/pserver/service.go:120-203)."""
+    from .. import io as io_mod
+    ckpt_dir = os.path.join(dirname, f"step_{step}")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    io_mod.save_persistables(executor, ckpt_dir, main_program=main_program)
+    meta = {"step": step, **(extra_meta or {})}
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".meta.tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(dirname, _META))
+
+
+def latest_checkpoint(dirname: str) -> Optional[dict]:
+    """Metadata of the newest complete checkpoint, or None."""
+    path = os.path.join(dirname, _META)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        meta = json.load(f)
+    ckpt_dir = os.path.join(dirname, f"step_{meta['step']}")
+    return meta if os.path.isdir(ckpt_dir) else None
+
+
+def load_checkpoint(executor, dirname: str, main_program=None) -> Optional[dict]:
+    """Restore persistables from the newest checkpoint; returns its metadata
+    (with 'step') or None when no checkpoint exists — the trainer resumes
+    at meta['step'] + 1 (master recover parity, go/master/service.go:166)."""
+    from .. import io as io_mod
+    meta = latest_checkpoint(dirname)
+    if meta is None:
+        return None
+    ckpt_dir = os.path.join(dirname, f"step_{meta['step']}")
+    io_mod.load_persistables(executor, ckpt_dir, main_program=main_program)
+    return meta

@@ -1,0 +1,118 @@
+"""Kill-and-resume checkpoint training (reference fault-tolerance story:
+go/master/service.go:166 recover, go/pserver/service.go:346 checkpoint load;
+here checkpointed synchronous training — elastic is descoped, see README)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel import multihost
+
+TRAINER = r'''
+import os, sys, json
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import paddle_tpu as fluid
+from paddle_tpu.parallel import multihost
+
+ckpt_dir = sys.argv[1]
+die_after = int(sys.argv[2])      # crash after this step (-1 = never)
+total_steps = int(sys.argv[3])
+
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, param_attr=fluid.ParamAttr(name="w"))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(startup)
+meta = multihost.load_checkpoint(exe, ckpt_dir, main_program=main)
+start = meta["step"] + 1 if meta else 0
+
+rng = np.random.RandomState(0)
+data = [(rng.randn(8, 4).astype(np.float32),) for _ in range(total_steps)]
+w_true = rng.randn(4, 1).astype(np.float32)
+
+for step in range(start, total_steps):
+    xs, = data[step]
+    exe.run(main, feed={"x": xs, "y": xs @ w_true}, fetch_list=[loss])
+    multihost.save_checkpoint(exe, ckpt_dir, step, main_program=main)
+    if step == die_after:
+        os._exit(17)              # simulated crash: no cleanup
+
+from paddle_tpu import executor as executor_mod
+w = np.asarray(executor_mod.global_scope().find_var("w"))
+print(json.dumps({"final_w": w.reshape(-1).tolist(), "start": start}))
+'''
+
+
+class TestKillAndResume:
+    def _run(self, ckpt_dir, die_after, total):
+        return subprocess.run(
+            [sys.executable, "-c", TRAINER, ckpt_dir, str(die_after),
+             str(total)],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        total = 6
+        # uninterrupted run
+        clean_dir = str(tmp_path / "clean")
+        os.makedirs(clean_dir)
+        r = self._run(clean_dir, -1, total)
+        assert r.returncode == 0, r.stderr[-2000:]
+        clean = json.loads(r.stdout.strip().splitlines()[-1])
+
+        # crashed at step 2, resumed
+        crash_dir = str(tmp_path / "crash")
+        os.makedirs(crash_dir)
+        r1 = self._run(crash_dir, 2, total)
+        assert r1.returncode == 17     # the simulated crash
+        r2 = self._run(crash_dir, -1, total)
+        assert r2.returncode == 0, r2.stderr[-2000:]
+        resumed = json.loads(r2.stdout.strip().splitlines()[-1])
+
+        assert resumed["start"] == 3   # resumed after the last checkpoint
+        np.testing.assert_allclose(resumed["final_w"], clean["final_w"],
+                                   rtol=1e-6)
+
+
+class TestShardReader:
+    def test_disjoint_partitions_cover_stream(self):
+        samples = list(range(23))
+        shards = [multihost.shard_reader(lambda: iter(samples),
+                                         num_shards=4, shard_id=i)
+                  for i in range(4)]
+        seen = [list(s()) for s in shards]
+        flat = sorted(x for part in seen for x in part)
+        assert flat == samples                      # full coverage
+        for i, part in enumerate(seen):             # disjoint + strided
+            assert part == samples[i::4]
+
+
+class TestCheckpointMeta:
+    def test_atomic_meta_and_latest(self, tmp_path):
+        d = str(tmp_path)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            v = fluid.layers.tensor.create_global_var(
+                shape=[2], value=1.5, dtype="float32", persistable=True,
+                name="pv")
+        exe = fluid.Executor(fluid.CPUPlace())
+        from paddle_tpu import executor as executor_mod
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(startup)
+            assert multihost.latest_checkpoint(d) is None
+            multihost.save_checkpoint(exe, d, 0, main_program=main)
+            multihost.save_checkpoint(exe, d, 1, main_program=main,
+                                      extra_meta={"pass": 0})
+            meta = multihost.latest_checkpoint(d)
+            assert meta["step"] == 1 and meta["pass"] == 0

@@ -1,0 +1,107 @@
+"""ModelAverage optimizer + average_accumulates op (reference:
+optimizer.py:811, average_accumulates_op.h, test_model_average tests)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+
+
+class TestModelAverage:
+    def test_apply_restores_and_averages(self):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=1,
+                                   param_attr=fluid.ParamAttr(name="w"),
+                                   bias_attr=False)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(learning_rate=0.2).minimize(loss)
+            model_avg = fluid.optimizer.ModelAverage(
+                average_window_rate=1.0, min_average_window=1,
+                max_average_window=1000)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        xs = rng.randn(16, 2).astype(np.float32)
+        w_true = np.array([[1.5], [-2.0]], np.float32)
+        ys = xs @ w_true
+        scope = executor_mod.Scope()
+        with executor_mod.scope_guard(scope):
+            exe.run(startup)
+            traj = []
+            for _ in range(6):
+                exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+                traj.append(np.asarray(scope.find_var("w")).copy())
+            trained = traj[-1]
+            want_avg = np.mean(traj, axis=0)
+            with model_avg.apply(exe):
+                inside = np.asarray(scope.find_var("w")).copy()
+                np.testing.assert_allclose(inside, want_avg, rtol=1e-5)
+                assert not np.allclose(inside, trained)
+            restored = np.asarray(scope.find_var("w"))
+            np.testing.assert_allclose(restored, trained, rtol=1e-7)
+
+
+class TestAverageAccumulatesOpSemantics:
+    def test_window_roll(self):
+        """Numpy step-by-step simulation of the reference kernel vs the op
+        across a window rollover."""
+        import jax
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            p = fluid.layers.data(name="p", shape=[3], dtype="float32",
+                                  append_batch_size=False)
+            blk = main.global_block()
+            vals = {}
+            for nm, shape, dt in [("s1", [3], "float32"), ("s2", [3], "float32"),
+                                  ("s3", [3], "float32"), ("na", [1], "int32"),
+                                  ("on", [1], "int32"), ("nu", [1], "int32")]:
+                vals[nm] = blk.create_var(name=nm, shape=shape, dtype=dt,
+                                          persistable=True)
+            blk.append_op(
+                type="average_accumulates",
+                inputs={"param": [p], "in_sum_1": [vals["s1"]],
+                        "in_sum_2": [vals["s2"]], "in_sum_3": [vals["s3"]],
+                        "in_num_accumulates": [vals["na"]],
+                        "in_old_num_accumulates": [vals["on"]],
+                        "in_num_updates": [vals["nu"]]},
+                outputs={"out_sum_1": [vals["s1"]], "out_sum_2": [vals["s2"]],
+                         "out_sum_3": [vals["s3"]],
+                         "out_num_accumulates": [vals["na"]],
+                         "out_old_num_accumulates": [vals["on"]],
+                         "out_num_updates": [vals["nu"]]},
+                attrs={"average_window": 0.5, "min_average_window": 2,
+                       "max_average_window": 3})
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = executor_mod.Scope()
+        with executor_mod.scope_guard(scope):
+            for nm, dt in [("s1", np.float32), ("s2", np.float32),
+                           ("s3", np.float32)]:
+                scope.set_var(nm, np.zeros(3, dt))
+            for nm in ("na", "on", "nu"):
+                scope.set_var(nm, np.zeros(1, np.int32))
+
+            # numpy oracle
+            s1 = np.zeros(3); s2 = np.zeros(3); s3 = np.zeros(3)
+            na = on = nu = 0
+            rng = np.random.RandomState(2)
+            for step in range(6):
+                pv = rng.rand(3).astype(np.float32)
+                exe.run(main, feed={"p": pv}, fetch_list=[vals["s1"]])
+                nu += 1; na += 1; s1 = s1 + pv
+                if na >= 2 and na >= min(3, int(nu * 0.5)):
+                    s3 = s1 + s2; s1 = np.zeros(3); s2 = np.zeros(3)
+                    on = na; na = 0
+                np.testing.assert_allclose(
+                    np.asarray(scope.find_var("s1")), s1, rtol=1e-6,
+                    err_msg=f"s1 step {step}")
+                np.testing.assert_allclose(
+                    np.asarray(scope.find_var("s3")), s3, rtol=1e-6,
+                    err_msg=f"s3 step {step}")
+                assert int(np.asarray(scope.find_var("na"))[0]) == na
+                assert int(np.asarray(scope.find_var("nu"))[0]) == nu

@@ -1,0 +1,105 @@
+"""Nested (level-2) LoD: feed/fetch roundtrip and a nested-RNN model
+(reference: lod_tensor.h:55 two-level offsets, test_dyn_rnn nested configs,
+RecurrentGradientMachine.h:32)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu.executor import LoDTensor
+
+RNG = np.random.RandomState(21)
+
+
+def make_nested(doc_sent_lens, d):
+    """doc_sent_lens: [[len(sent) for sent in doc] for doc]."""
+    rows, outer, inner = [], [0], [0]
+    for doc in doc_sent_lens:
+        outer.append(outer[-1] + len(doc))
+        for sl in doc:
+            rows.append(RNG.randn(sl, d).astype(np.float32))
+            inner.append(inner[-1] + sl)
+    return LoDTensor(np.concatenate(rows, axis=0), [outer, inner]), rows
+
+
+class TestNestedRoundtrip:
+    def test_feed_fetch_identity(self):
+        lod_t, rows = make_nested([[2, 3], [1], [4, 2, 1]], 3)
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                                  lod_level=2)
+            y = fluid.layers.scale(x, scale=2.0)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                got, = exe.run(fluid.default_main_program(),
+                               feed={"x": lod_t}, fetch_list=[y],
+                               return_numpy=False)
+        assert isinstance(got, LoDTensor)
+        assert got.lod == lod_t.lod
+        np.testing.assert_allclose(got.array(),
+                                   2 * np.asarray(lod_t.array()), rtol=1e-6)
+
+
+class TestNestedModel:
+    def test_hierarchical_pooling(self):
+        """sum words within each sentence, then sum sentences within each
+        doc — checked against a per-document numpy oracle."""
+        structure = [[2, 3], [1], [4, 2, 1]]
+        lod_t, rows = make_nested(structure, 3)
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                                  lod_level=2)
+            flat = fluid.layers.sequence_unfold(x)          # [B*S, T, 3]
+            sent = fluid.layers.sequence_pool(flat, "sum")  # [B*S, 3]
+            docs = fluid.layers.sequence_fold(sent, x)      # [B, S, 3]
+            doc = fluid.layers.sequence_pool(docs, "sum")   # [B, 3]
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                got, = exe.run(fluid.default_main_program(),
+                               feed={"x": lod_t}, fetch_list=[doc])
+        idx = 0
+        want = []
+        for dl in structure:
+            tot = np.zeros(3, np.float32)
+            for _ in dl:
+                tot += rows[idx].sum(0)
+                idx += 1
+            want.append(tot)
+        np.testing.assert_allclose(np.asarray(got), np.stack(want),
+                                   rtol=1e-5)
+
+    def test_nested_rnn_trains(self):
+        """Inner GRU over words, pool, outer GRU over sentences — the
+        nested-RNN pattern of test_dyn_rnn's nested config, trained a few
+        steps."""
+        structure = [[2, 3], [3, 1]]
+        lod_t, _ = make_nested(structure, 4)
+        lbl = np.array([[0], [1]], np.int64)
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                                  lod_level=2)
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            flat = fluid.layers.sequence_unfold(x)
+            proj = fluid.layers.fc(input=flat, size=18, num_flatten_dims=2)
+            inner = fluid.layers.dynamic_gru(input=proj, size=6)
+            sent = fluid.layers.sequence_last_step(inner)     # [B*S, 6]
+            docs = fluid.layers.sequence_fold(sent, x)        # [B, S, 6]
+            proj2 = fluid.layers.fc(input=docs, size=18, num_flatten_dims=2)
+            outer = fluid.layers.dynamic_gru(input=proj2, size=6)
+            doc = fluid.layers.sequence_last_step(outer)      # [B, 6]
+            logits = fluid.layers.fc(input=doc, size=2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                exe.run(fluid.default_startup_program())
+                first = None
+                for _ in range(25):
+                    v, = exe.run(fluid.default_main_program(),
+                                 feed={"x": lod_t, "y": lbl},
+                                 fetch_list=[loss])
+                    first = first if first is not None else \
+                        float(np.asarray(v).reshape(-1)[0])
+                last = float(np.asarray(v).reshape(-1)[0])
+        assert last < first * 0.5, (first, last)

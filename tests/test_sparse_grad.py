@@ -1,0 +1,93 @@
+"""SelectedRows sparse embedding gradients (reference: selected_rows.h:19,
+lookup_table_op.cc sparse grad path, selected_rows_functor.cc,
+test_lookup_table_op.py). Sparse path must match the dense path bit-for-bit
+on the updated table, and a word2vec-style step must train through it."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+
+RNG = np.random.RandomState(9)
+
+
+def _train_once(is_sparse, steps=3):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[4], dtype="int64")
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[50, 8], is_sparse=is_sparse,
+                                     param_attr=fluid.ParamAttr(name="emb_w"))
+        flat = fluid.layers.reshape(emb, shape=[-1, 32])
+        logits = fluid.layers.fc(input=flat, size=50,
+                                 param_attr=fluid.ParamAttr(name="fc_w"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, lbl))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    feed = {"ids": RNG.RandomState if False else np.array(
+                [[1, 7, 7, 3], [0, 2, 2, 2]], np.int64),
+            "lbl": np.array([[5], [9]], np.int64)}
+    with executor_mod.scope_guard(scope):
+        exe.run(startup)
+        # deterministic init so sparse/dense runs start identical
+        scope.set_var("emb_w", np.linspace(
+            -1, 1, 50 * 8).astype(np.float32).reshape(50, 8))
+        scope.set_var("fc_w", np.linspace(
+            -0.5, 0.5, 32 * 50).astype(np.float32).reshape(32, 50))
+        losses = []
+        for _ in range(steps):
+            v, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(v).reshape(-1)[0]))
+        w = np.asarray(scope.find_var("emb_w"))
+    return losses, w
+
+
+class TestSparseEmbeddingGrad:
+    def test_sparse_matches_dense(self):
+        l_dense, w_dense = _train_once(is_sparse=False)
+        l_sparse, w_sparse = _train_once(is_sparse=True)
+        # scatter-add order differs between the two paths; only float
+        # accumulation noise is tolerated
+        np.testing.assert_allclose(l_sparse, l_dense, rtol=1e-5)
+        np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-5, atol=1e-7)
+        # rows never looked up must be untouched by the sparse update
+        init = np.linspace(-1, 1, 50 * 8).astype(np.float32).reshape(50, 8)
+        touched = {0, 1, 2, 3, 7}
+        untouched = [i for i in range(50) if i not in touched]
+        np.testing.assert_array_equal(w_sparse[untouched], init[untouched])
+
+    def test_word2vec_step_sparse(self):
+        """CBOW-style word2vec step through the sparse path converges
+        (reference book test_word2vec config with is_sparse=True)."""
+        main = fluid.Program()
+        startup = fluid.Program()
+        V, E = 40, 16
+        with fluid.program_guard(main, startup):
+            words = [fluid.layers.data(name=f"w{i}", shape=[1],
+                                       dtype="int64") for i in range(4)]
+            target = fluid.layers.data(name="tgt", shape=[1], dtype="int64")
+            embs = [fluid.layers.embedding(
+                w, size=[V, E], is_sparse=True,
+                param_attr=fluid.ParamAttr(name="shared_emb"))
+                for w in words]
+            concat = fluid.layers.concat(embs, axis=1)
+            hidden = fluid.layers.fc(input=concat, size=32, act="sigmoid")
+            logits = fluid.layers.fc(input=hidden, size=V)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, target))
+            fluid.optimizer.SGDOptimizer(learning_rate=1.0).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        data = RNG.randint(0, V, size=(16, 5)).astype(np.int64)
+        feed = {f"w{i}": data[:, i:i+1] for i in range(4)}
+        feed["tgt"] = data[:, 4:5]
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(startup)
+            first = None
+            for _ in range(30):
+                v, = exe.run(main, feed=feed, fetch_list=[loss])
+                first = first or float(np.asarray(v).reshape(-1)[0])
+            last = float(np.asarray(v).reshape(-1)[0])
+        assert last < first * 0.5, (first, last)

@@ -1,0 +1,144 @@
+"""Round-2 hardening: multi-target calc_gradient, control-flow-aware prune
+(save_inference_model through a While), jit-path NaN/Inf check (reference:
+backward.py:555 calc_gradient, prune.cc:181 recursion, executor.cc:325-333
+FLAGS_check_nan_inf)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+
+
+def run_prog(feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(fluid.default_main_program(), feed=feed, fetch_list=fetch)
+
+
+class TestCalcGradient:
+    def test_single_target(self):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                                  append_batch_size=False,
+                                  stop_gradient=False)
+            y = fluid.layers.reduce_sum(fluid.layers.elementwise_mul(x, x))
+            (gx,) = fluid.calc_gradient(y, x)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                xv = np.array([1.0, -2.0, 3.0], np.float32)
+                g, = exe.run(fluid.default_main_program(), feed={"x": xv},
+                             fetch_list=[gx])
+        np.testing.assert_allclose(np.asarray(g), 2 * xv, rtol=1e-6)
+
+    def test_multi_target_with_cotangents(self):
+        """grad of <tg1, t1> + <tg2, t2> — the reference's multi-target
+        semantics (test_calc_gradient.py)."""
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                                  append_batch_size=False,
+                                  stop_gradient=False)
+            t1 = fluid.layers.scale(x, scale=3.0)       # dt1/dx = 3
+            t2 = fluid.layers.elementwise_mul(x, x)     # dt2/dx = 2x
+            tg1 = fluid.layers.fill_constant([2], "float32", 2.0)
+            tg2 = fluid.layers.fill_constant([2], "float32", 0.5)
+            (gx,) = fluid.calc_gradient([t1, t2], x,
+                                        target_gradients=[tg1, tg2])
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                xv = np.array([1.0, -4.0], np.float32)
+                g, = exe.run(fluid.default_main_program(), feed={"x": xv},
+                             fetch_list=[gx])
+        want = 2.0 * 3.0 + 0.5 * 2 * xv
+        np.testing.assert_allclose(np.asarray(g), want, rtol=1e-6)
+
+
+class TestPruneThroughControlFlow:
+    def _build(self):
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                              append_batch_size=False)
+        # upstream op whose output is consumed ONLY inside the while body
+        doubled = fluid.layers.scale(x, scale=2.0)
+        i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="int64", value=3)
+        acc = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+        cond = fluid.layers.less_than(x=i, y=limit)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            new_acc = fluid.layers.elementwise_add(acc, doubled)
+            fluid.layers.assign(new_acc, acc)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(x=i, y=limit, cond=cond)
+        # decoy op that must be pruned away
+        decoy = fluid.layers.scale(x, scale=100.0)
+        return x, acc, decoy
+
+    def test_prune_keeps_subblock_producers(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x, acc, decoy = self._build()
+        pruned = main.prune(feeds=["x"], fetches=[acc.name])
+        kept_types = [op.type for op in pruned.global_block().ops]
+        # the producer feeding the while body must survive
+        assert kept_types.count("scale") == 1, kept_types
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            got, = exe.run(pruned, feed={"x": np.array([5.0], np.float32)},
+                           fetch_list=[acc.name])
+        assert float(np.asarray(got)[0]) == 30.0   # 3 iterations of +10
+
+    def test_save_load_inference_model_with_while(self, tmp_path):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x, acc, _ = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(startup)
+            want, = exe.run(main, feed={"x": np.array([2.0], np.float32)},
+                            fetch_list=[acc.name])
+            fluid.io.save_inference_model(str(tmp_path), ["x"], [acc], exe,
+                                          main_program=main)
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            prog, feed_names, fetch_targets = \
+                fluid.io.load_inference_model(str(tmp_path), exe)
+            got, = exe.run(prog, feed={"x": np.array([2.0], np.float32)},
+                           fetch_list=fetch_targets)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+class TestJitNanCheck:
+    def test_nan_raises_with_var_name(self, monkeypatch):
+        monkeypatch.setattr(executor_mod, "_CHECK_NAN_INF", True)
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                                  append_batch_size=False)
+            y = fluid.layers.log(x)    # log(-1) = nan
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                with pytest.raises(RuntimeError, match="NaN/Inf.*'"):
+                    exe.run(fluid.default_main_program(),
+                            feed={"x": np.array([-1.0, 1.0], np.float32)},
+                            fetch_list=[y])
+
+
+class TestEnforceStyleErrors:
+    def test_lowering_failure_names_op_and_shapes(self):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[2, 3], dtype="float32",
+                                  append_batch_size=False)
+            y = fluid.layers.data(name="y", shape=[4, 5], dtype="float32",
+                                  append_batch_size=False)
+            out = fluid.layers.elementwise_add(x, y)   # incompatible
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                with pytest.raises(RuntimeError) as ei:
+                    exe.run(fluid.default_main_program(),
+                            feed={"x": np.zeros((2, 3), np.float32),
+                                  "y": np.zeros((4, 5), np.float32)},
+                            fetch_list=[out])
+        msg = str(ei.value)
+        assert "elementwise_add" in msg and "input shapes" in msg
