@@ -1,0 +1,58 @@
+/* C inference example: load a fit_a_line model saved by
+ * fluid.io.save_inference_model and predict (reference:
+ * paddle/capi/examples/model_inference/dense/main.c).
+ *
+ * Build:
+ *   make -C paddle_tpu/native libpaddle_tpu_capi.so
+ *   gcc infer_fit_a_line.c -I paddle_tpu/native -L paddle_tpu/native \
+ *       -lpaddle_tpu_capi -o infer_fit_a_line
+ * Run (interpreter deps resolved via PYTHONPATH):
+ *   LD_LIBRARY_PATH=paddle_tpu/native ./infer_fit_a_line <model_dir>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "capi.h"
+
+#define CHECK(stmt)                                          \
+  do {                                                       \
+    paddle_error e__ = (stmt);                               \
+    if (e__ != PD_NO_ERROR) {                                \
+      fprintf(stderr, "error %d at %s\n", e__, #stmt);       \
+      return 1;                                              \
+    }                                                        \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_dir>\n", argv[0]);
+    return 2;
+  }
+  CHECK(paddle_tpu_init());
+
+  paddle_tpu_machine machine;
+  CHECK(paddle_tpu_machine_create(&machine, argv[1]));
+
+  /* two rows of the 13-feature uci_housing input */
+  float x[2][13];
+  int i, j;
+  for (i = 0; i < 2; ++i)
+    for (j = 0; j < 13; ++j) x[i][j] = 0.1f * (float)(i + 1) * (float)j;
+  int64_t dims[2] = {2, 13};
+  CHECK(paddle_tpu_machine_set_input(machine, "x", &x[0][0], dims, 2));
+
+  CHECK(paddle_tpu_machine_forward(machine));
+
+  int count = 0;
+  CHECK(paddle_tpu_machine_output_count(machine, &count));
+  const float* out;
+  const int64_t* out_dims;
+  int ndim;
+  CHECK(paddle_tpu_machine_get_output(machine, 0, &out, &out_dims, &ndim));
+  printf("outputs=%d ndim=%d shape=[%lld,%lld]\n", count, ndim,
+         (long long)out_dims[0], (long long)out_dims[1]);
+  for (i = 0; i < (int)out_dims[0]; ++i) printf("pred[%d]=%.6f\n", i, out[i]);
+
+  CHECK(paddle_tpu_machine_destroy(machine));
+  return 0;
+}

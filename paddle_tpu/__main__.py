@@ -1,0 +1,6 @@
+"""`python -m paddle_tpu <job>` — the `paddle` CLI (see cli.py)."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

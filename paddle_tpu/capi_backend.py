@@ -1,0 +1,49 @@
+"""Python side of the C inference API (native/capi.cc embeds CPython and
+drives this module; reference: paddle/capi/gradient_machine.h fronted the
+C++ GradientMachine the same way).
+
+Machine wraps load_inference_model + a private scope; inputs arrive as raw
+float32 bytes + dims from C, outputs go back the same way."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class Machine:
+    def __init__(self, model_dir: str):
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod
+
+        self._fluid = fluid
+        self._executor_mod = executor_mod
+        self._scope = executor_mod.Scope()
+        self._exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(self._scope):
+            (self._program, self._feed_names,
+             self._fetch_targets) = fluid.io.load_inference_model(
+                model_dir, self._exe)
+        self._inputs: Dict[str, np.ndarray] = {}
+
+    def set_input(self, name: str, payload: bytes, dims: Tuple[int, ...]):
+        if name not in self._feed_names:
+            raise KeyError(
+                f"'{name}' is not a feed of this model; feeds: "
+                f"{self._feed_names}")
+        arr = np.frombuffer(payload, dtype=np.float32).reshape(dims).copy()
+        self._inputs[name] = arr
+
+    def forward(self) -> List[Tuple[bytes, Tuple[int, ...]]]:
+        missing = [n for n in self._feed_names if n not in self._inputs]
+        if missing:
+            raise ValueError(f"missing inputs: {missing}")
+        with self._executor_mod.scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=dict(self._inputs),
+                                 fetch_list=self._fetch_targets)
+        result = []
+        for o in outs:
+            a = np.ascontiguousarray(np.asarray(o), dtype=np.float32)
+            result.append((a.tobytes(), tuple(int(d) for d in a.shape)))
+        return result

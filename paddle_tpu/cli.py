@@ -1,0 +1,186 @@
+"""`paddle train`-style command line (reference:
+paddle/trainer/TrainerMain.cpp:32-64 — jobs train/test/time driven by
+--config; paddle/scripts/submit_local.sh.in:3-13 the `paddle` wrapper).
+
+Usage:
+    python -m paddle_tpu train --config=conf.py [--epochs N] [--save-dir D]
+                               [--checkpoint-dir C] [--resume]
+    python -m paddle_tpu time  --config=conf.py [--steps N]
+    python -m paddle_tpu infer --model-dir=D --input=batch.npz
+    python -m paddle_tpu version
+
+The config file is a Python module (the reference's --config was a Python
+DSL file too, parsed by config_parser.py) defining:
+
+    def build():
+        ...build programs, apply an optimizer...
+        return {"main_program": main, "startup_program": startup,
+                "feed_order": ["x", "y"], "loss": loss_var,
+                # optional: "fetch": [vars], "feed_targets": [vars]}
+
+    def train_reader():   # yields per-sample tuples matching feed_order
+        ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+import time as time_mod
+
+
+def _load_config(path):
+    spec = importlib.util.spec_from_file_location("paddle_tpu_config", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if not hasattr(mod, "build"):
+        raise SystemExit(f"config '{path}' must define build()")
+    return mod
+
+
+def _feeder(fluid, cfg, spec):
+    feed_targets = spec.get("feed_targets")
+    if feed_targets is None:
+        block = spec["main_program"].global_block()
+        feed_targets = [block.var(n) for n in spec["feed_order"]]
+    return fluid.DataFeeder(feed_list=feed_targets, place=fluid.TPUPlace(0))
+
+
+def cmd_train(args):
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import multihost
+
+    cfg = _load_config(args.config)
+    spec = cfg.build()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(spec["startup_program"])
+
+    start_epoch = 0
+    if args.checkpoint_dir and args.resume:
+        meta = multihost.load_checkpoint(exe, args.checkpoint_dir,
+                                         main_program=spec["main_program"])
+        if meta:
+            start_epoch = meta["step"] + 1
+            print(f"resumed from checkpoint epoch {meta['step']}")
+
+    feeder = _feeder(fluid, cfg, spec)
+    import paddle_tpu.minibatch as minibatch
+    batched = minibatch.batch(cfg.train_reader, batch_size=args.batch_size)
+
+    loss_name = spec["loss"].name
+    for epoch in range(start_epoch, args.epochs):
+        t0 = time_mod.perf_counter()
+        last = None
+        n = 0
+        for data in batched():
+            last, = exe.run(spec["main_program"], feed=feeder.feed(data),
+                            fetch_list=[loss_name])
+            n += 1
+        dt = time_mod.perf_counter() - t0
+        import numpy as np
+        print(f"epoch {epoch}: loss={float(np.asarray(last).ravel()[0]):.6f}"
+              f" ({n} steps, {dt:.1f}s)")
+        if args.checkpoint_dir:
+            multihost.save_checkpoint(exe, args.checkpoint_dir, epoch,
+                                      main_program=spec["main_program"])
+    if args.save_dir:
+        fetch = spec.get("fetch") or [spec["loss"]]
+        fluid.io.save_inference_model(args.save_dir, spec["feed_order"],
+                                      fetch, exe,
+                                      main_program=spec["main_program"])
+        print(f"saved inference model to {args.save_dir}")
+    return 0
+
+
+def cmd_time(args):
+    """--job=time parity (reference TrainerBenchmark.cpp): steps/sec over
+    synthetic repeats of the first batch."""
+    import numpy as np
+    import paddle_tpu as fluid
+    import paddle_tpu.minibatch as minibatch
+
+    cfg = _load_config(args.config)
+    spec = cfg.build()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(spec["startup_program"])
+    feeder = _feeder(fluid, cfg, spec)
+    batched = minibatch.batch(cfg.train_reader, batch_size=args.batch_size)
+    data = next(iter(batched()))
+    feed = feeder.feed(data)
+    loss_name = spec["loss"].name
+    for _ in range(3):
+        exe.run(spec["main_program"], feed=feed, fetch_list=[loss_name])
+    t0 = time_mod.perf_counter()
+    for _ in range(args.steps):
+        out, = exe.run(spec["main_program"], feed=feed,
+                       fetch_list=[loss_name], return_numpy=False)
+    float(np.asarray(out).ravel()[0])
+    dt = time_mod.perf_counter() - t0
+    print(f"{args.steps} steps in {dt:.2f}s -> {args.steps / dt:.2f} steps/s")
+    return 0
+
+
+def cmd_infer(args):
+    import numpy as np
+    import paddle_tpu as fluid
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    prog, feed_names, fetch_targets = fluid.io.load_inference_model(
+        args.model_dir, exe)
+    data = np.load(args.input)
+    feed = {n: data[n] for n in feed_names}
+    outs = exe.run(prog, feed=feed, fetch_list=fetch_targets)
+    for name, val in zip([v.name for v in fetch_targets], outs):
+        arr = np.asarray(val)
+        print(f"{name} shape={list(arr.shape)}")
+        np.savetxt(sys.stdout, arr.reshape(arr.shape[0], -1), fmt="%.6f")
+    return 0
+
+
+def cmd_version(_args):
+    import paddle_tpu
+    import jax
+    print(f"paddle_tpu {getattr(paddle_tpu, '__version__', '0.2.0')} "
+          f"(jax {jax.__version__}, "
+          f"devices: {[d.platform for d in jax.local_devices()]})")
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu",
+        description="TPU-native trainer CLI (reference `paddle train`)")
+    sub = parser.add_subparsers(dest="job", required=True)
+
+    p_train = sub.add_parser("train", help="train a --config model")
+    p_train.add_argument("--config", required=True)
+    p_train.add_argument("--epochs", type=int, default=1)
+    p_train.add_argument("--batch-size", type=int, default=32)
+    p_train.add_argument("--save-dir", default=None)
+    p_train.add_argument("--checkpoint-dir", default=None)
+    p_train.add_argument("--resume", action="store_true")
+    p_train.set_defaults(fn=cmd_train)
+
+    p_time = sub.add_parser("time", help="steps/sec benchmark of a config")
+    p_time.add_argument("--config", required=True)
+    p_time.add_argument("--steps", type=int, default=20)
+    p_time.add_argument("--batch-size", type=int, default=32)
+    p_time.set_defaults(fn=cmd_time)
+
+    p_infer = sub.add_parser("infer", help="run a saved inference model")
+    p_infer.add_argument("--model-dir", required=True)
+    p_infer.add_argument("--input", required=True,
+                         help=".npz with one array per feed name")
+    p_infer.set_defaults(fn=cmd_infer)
+
+    p_ver = sub.add_parser("version")
+    p_ver.set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

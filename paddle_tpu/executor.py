@@ -257,6 +257,18 @@ class LoweringContext:
 _EAGER = os.environ.get("PADDLE_TPU_EAGER", "0") == "1"
 _CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
 
+# op-coverage recorder (tools/op_coverage.py): append every executed op type
+# to the named file so a test sweep can prove each registered op runs
+_RECORD_OPS_PATH = os.environ.get("PADDLE_TPU_RECORD_OPS")
+_RECORDED_OPS = set()
+
+
+def _record_op(op_type: str):
+    if _RECORD_OPS_PATH and op_type not in _RECORDED_OPS:
+        _RECORDED_OPS.add(op_type)
+        with open(_RECORD_OPS_PATH, "a") as f:
+            f.write(op_type + "\n")
+
 SEQLEN_SUFFIX = "@SEQLEN"
 SEQLEN2_SUFFIX = "@SEQLEN2"   # inner lengths [B, S] of nested (level-2) LoD
 
@@ -557,6 +569,7 @@ class Executor:
     def _exec_op(self, ctx: LoweringContext, op, env: Dict[str, Any]):
         if op.type in ("feed", "fetch"):
             return
+        _record_op(op.type)
         try:
             opdef = registry.get(op.type)
         except KeyError as e:
@@ -688,11 +701,32 @@ class Executor:
 
     def _compile(self, program, state_names, feed_names, fetch_names,
                  persist_out, lod_map) -> _CompiledBlock:
-        def fn(feed_vals, state_vals, rng_key):
-            return self._trace_block(program, feed_vals, state_vals,
-                                     fetch_names, persist_out, rng_key, lod_map)
-
         mesh = getattr(program, "_mesh", None)
+        param_specs = getattr(program, "_param_shardings", {})
+
+        def fn(feed_vals, state_vals, rng_key):
+            fetch, lens, new_state = self._trace_block(
+                program, feed_vals, state_vals, fetch_names, persist_out,
+                rng_key, lod_map)
+            if mesh is not None:
+                # pin state outputs to the same shardings the next run's
+                # in_shardings expect (annotated params keep their spec,
+                # everything else replicated) — otherwise XLA may choose a
+                # sharded layout for an output and the donated round-trip
+                # mismatches on the following step
+                from jax.sharding import NamedSharding, PartitionSpec
+                pinned = {}
+                for n, v in new_state.items():
+                    spec = param_specs.get(n)
+                    sh = NamedSharding(mesh, PartitionSpec(*spec)) if spec \
+                        else NamedSharding(mesh, PartitionSpec())
+                    try:
+                        pinned[n] = jax.lax.with_sharding_constraint(v, sh)
+                    except (TypeError, ValueError):
+                        pinned[n] = v
+                new_state = pinned
+            return fetch, lens, new_state
+
         if mesh is not None:
             # SPMD: feeds sharded along batch over the 'dp' axis, state
             # (parameters/accumulators) replicated. XLA GSPMD inserts the
@@ -702,12 +736,21 @@ class Executor:
             repl = NamedSharding(mesh, PartitionSpec())
             dp = mesh.axis_names[0]
 
+            # per-parameter PartitionSpec annotations (tensor / ZeRO
+            # sharding, parallel/tensor_parallel.py); unannotated state is
+            # replicated and XLA GSPMD partitions the consumers
+            state_shardings = {}
+            for n in state_names:
+                spec = param_specs.get(n)
+                state_shardings[n] = repl if spec is None else \
+                    NamedSharding(mesh, PartitionSpec(*spec))
+
             jitted = jax.jit(
                 fn, donate_argnums=(1,),
                 in_shardings=(
                     {n: NamedSharding(
                         mesh, PartitionSpec(dp)) for n in feed_names},
-                    {n: repl for n in state_names},
+                    state_shardings,
                     repl))
         else:
             jitted = jax.jit(fn, donate_argnums=(1,))

@@ -38,6 +38,39 @@ __all__ = [
 GRAD_VAR_SUFFIX = "@GRAD"
 
 
+def op_external_reads(program, op) -> set:
+    """Names an op reads, including everything its sub-blocks read from
+    outside themselves (reference prune.cc:181 recurses into block attrs —
+    a while/conditional_block depends on its upstream producers even when
+    the root op desc only lists e.g. Cond)."""
+    reads = set(op.input_arg_names)
+    sub_idxs = []
+    for a in op.desc.attrs.values():
+        if isinstance(a, BlockRef):
+            sub_idxs.append(a.idx)
+        elif isinstance(a, BlocksRef):
+            sub_idxs.extend(a.idxs)
+    seen = set()
+    while sub_idxs:
+        si = sub_idxs.pop()
+        if si in seen:
+            continue
+        seen.add(si)
+        sub = program.block(si)
+        produced = set()
+        for sop in sub.ops:
+            for name in sop.input_arg_names:
+                if name not in produced and not sub.desc.has_var(name):
+                    reads.add(name)
+            produced.update(sop.output_arg_names)
+            for a in sop.desc.attrs.values():
+                if isinstance(a, BlockRef):
+                    sub_idxs.append(a.idx)
+                elif isinstance(a, BlocksRef):
+                    sub_idxs.extend(a.idxs)
+    return reads
+
+
 def grad_var_name(name: str) -> str:
     return name + GRAD_VAR_SUFFIX
 
@@ -396,7 +429,8 @@ class Program:
         # in the desc; here they are Python-side program state): mesh tag,
         # AMP policy, bound reader pipelines
         p._mesh = getattr(self, "_mesh", None)
-        for attr in ("_amp_dtype", "_amp_level", "_pipeline_readers"):
+        for attr in ("_amp_dtype", "_amp_level", "_pipeline_readers",
+                     "_param_shardings"):
             if hasattr(self, attr):
                 setattr(p, attr, getattr(self, attr))
         p.blocks = [Block(p, i) for i in range(len(p.desc.blocks))]
@@ -433,35 +467,7 @@ class Program:
         block = pruned.global_block()
 
         def op_reads(op):
-            """Inputs of an op including everything its sub-blocks read from
-            the outside (reference prune.cc:181 recurses into block attrs —
-            a while/conditional_block keeps its upstream producers)."""
-            reads = set(op.input_arg_names)
-            sub_idxs = []
-            for a in op.desc.attrs.values():
-                if isinstance(a, BlockRef):
-                    sub_idxs.append(a.idx)
-                elif isinstance(a, BlocksRef):
-                    sub_idxs.extend(a.idxs)
-            seen = set()
-            while sub_idxs:
-                si = sub_idxs.pop()
-                if si in seen:
-                    continue
-                seen.add(si)
-                sub = pruned.block(si)
-                produced = set()
-                for sop in sub.ops:
-                    for name in sop.input_arg_names:
-                        if name not in produced and not sub.desc.has_var(name):
-                            reads.add(name)
-                    produced.update(sop.output_arg_names)
-                    for a in sop.desc.attrs.values():
-                        if isinstance(a, BlockRef):
-                            sub_idxs.append(a.idx)
-                        elif isinstance(a, BlocksRef):
-                            sub_idxs.extend(a.idxs)
-            return reads
+            return op_external_reads(pruned, op)
 
         needed = set(fetches)
         keep: List[int] = []

@@ -143,9 +143,18 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
     pruned = main_program.prune(feeded_var_names,
                                 [t.name for t in target_vars])
     inference_program = pruned.clone(for_test=True)
+    # feeds the targets do not depend on were pruned away; drop them from
+    # the recorded feed list so inference callers need not supply them
+    # (e.g. the label input of a training program)
+    from .framework.framework import op_external_reads
+    block = inference_program.global_block()
+    live = set()
+    for op_ in block.ops:
+        live |= op_external_reads(inference_program, op_)
+    feed_names = [n for n in feeded_var_names if n in live]
     meta = {
         "program": inference_program.to_json(),
-        "feed_names": list(feeded_var_names),
+        "feed_names": feed_names,
         "fetch_names": [t.name for t in target_vars],
     }
     model_path = os.path.join(dirname, model_filename or "__model__")

@@ -61,11 +61,20 @@ class FileReader:
         self.batched = False
         self.buffered = False           # double_buffer applied
         self._iter = None
+        self._feeder = None
 
     def reset(self):
+        # stop a live prefetch thread so it does not stay blocked on the
+        # queue holding device-resident batches across passes
+        if self._feeder is not None:
+            self._feeder.stop()
+            self._feeder = None
         self._iter = None
 
     def _start(self, device):
+        if self._feeder is not None:
+            self._feeder.stop()
+            self._feeder = None
         it = self.source()
         if self.buffered:
             from ..reader.pipeline import DoubleBufferedFeeder
@@ -85,6 +94,7 @@ class FileReader:
 
             dbf = DoubleBufferedFeeder(
                 lambda: self.source(), to_feed=to_feed, device=None)
+            self._feeder = dbf
             it = (d["__tuple__"] for d in dbf)
         self._iter = iter(it)
 

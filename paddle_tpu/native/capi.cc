@@ -1,0 +1,185 @@
+// C inference shim over the XLA executor via embedded CPython.
+// See capi.h for the API contract (reference: paddle/capi/gradient_machine.h,
+// paddle/capi/main.h paddle_init). Build: `make libpaddle_tpu_capi.so`.
+
+#include "capi.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::once_flag g_init_flag;
+bool g_init_ok = false;
+
+struct Machine {
+  PyObject* py_machine = nullptr;   // paddle_tpu.capi_backend.Machine
+  // last forward's outputs, copied out of Python so the borrowed views in
+  // paddle_tpu_machine_get_output stay valid without holding the GIL
+  std::vector<std::vector<float>> out_data;
+  std::vector<std::vector<int64_t>> out_dims;
+};
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void init_python() {
+  bool fresh = !Py_IsInitialized();
+  if (fresh) {
+    Py_InitializeEx(0);
+  }
+  // Py_InitializeEx leaves this thread holding the GIL; do the warm-up
+  // import directly under it (no PyGILState guard — its Release must not
+  // run after the thread state is detached below).
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.capi_backend");
+  if (mod == nullptr) {
+    PyErr_Print();
+    g_init_ok = false;
+    return;
+  }
+  Py_DECREF(mod);
+  g_init_ok = true;
+  if (fresh) {
+    // detach the GIL so every API entry (this thread included) goes
+    // uniformly through PyGILState_Ensure
+    PyEval_SaveThread();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+paddle_error paddle_tpu_init(void) {
+  std::call_once(g_init_flag, init_python);
+  return g_init_ok ? PD_NO_ERROR : PD_UNDEFINED_ERROR;
+}
+
+paddle_error paddle_tpu_machine_create(paddle_tpu_machine* machine,
+                                       const char* model_dir) {
+  if (machine == nullptr || model_dir == nullptr) return PD_NULLPTR;
+  paddle_error err = paddle_tpu_init();
+  if (err != PD_NO_ERROR) return err;
+  Gil gil;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.capi_backend");
+  if (mod == nullptr) {
+    PyErr_Print();
+    return PD_UNDEFINED_ERROR;
+  }
+  PyObject* obj =
+      PyObject_CallMethod(mod, "Machine", "s", model_dir);
+  Py_DECREF(mod);
+  if (obj == nullptr) {
+    PyErr_Print();
+    return PD_PROTOBUF_ERROR;  // model artifact unreadable
+  }
+  Machine* m = new Machine();
+  m->py_machine = obj;
+  *machine = m;
+  return PD_NO_ERROR;
+}
+
+paddle_error paddle_tpu_machine_set_input(paddle_tpu_machine machine,
+                                          const char* name,
+                                          const float* data,
+                                          const int64_t* dims, int ndim) {
+  if (machine == nullptr || name == nullptr || data == nullptr ||
+      dims == nullptr)
+    return PD_NULLPTR;
+  Machine* m = static_cast<Machine*>(machine);
+  int64_t numel = 1;
+  for (int i = 0; i < ndim; ++i) numel *= dims[i];
+  Gil gil;
+  PyObject* dims_tuple = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(dims_tuple, i, PyLong_FromLongLong(dims[i]));
+  PyObject* payload = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), numel * sizeof(float));
+  PyObject* r = PyObject_CallMethod(m->py_machine, "set_input", "sOO", name,
+                                    payload, dims_tuple);
+  Py_DECREF(payload);
+  Py_DECREF(dims_tuple);
+  if (r == nullptr) {
+    PyErr_Print();
+    return PD_OUT_OF_RANGE;
+  }
+  Py_DECREF(r);
+  return PD_NO_ERROR;
+}
+
+paddle_error paddle_tpu_machine_forward(paddle_tpu_machine machine) {
+  if (machine == nullptr) return PD_NULLPTR;
+  Machine* m = static_cast<Machine*>(machine);
+  Gil gil;
+  // forward() -> list of (bytes, dims_tuple)
+  PyObject* outs = PyObject_CallMethod(m->py_machine, "forward", nullptr);
+  if (outs == nullptr) {
+    PyErr_Print();
+    return PD_UNDEFINED_ERROR;
+  }
+  m->out_data.clear();
+  m->out_dims.clear();
+  Py_ssize_t n = PyList_Size(outs);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* pair = PyList_GetItem(outs, i);            // borrowed
+    PyObject* payload = PyTuple_GetItem(pair, 0);        // borrowed
+    PyObject* dims = PyTuple_GetItem(pair, 1);           // borrowed
+    char* buf;
+    Py_ssize_t len;
+    PyBytes_AsStringAndSize(payload, &buf, &len);
+    std::vector<float> vals(len / sizeof(float));
+    std::memcpy(vals.data(), buf, len);
+    std::vector<int64_t> shape;
+    for (Py_ssize_t d = 0; d < PyTuple_Size(dims); ++d)
+      shape.push_back(PyLong_AsLongLong(PyTuple_GetItem(dims, d)));
+    m->out_data.push_back(std::move(vals));
+    m->out_dims.push_back(std::move(shape));
+  }
+  Py_DECREF(outs);
+  return PD_NO_ERROR;
+}
+
+paddle_error paddle_tpu_machine_output_count(paddle_tpu_machine machine,
+                                             int* count) {
+  if (machine == nullptr || count == nullptr) return PD_NULLPTR;
+  *count = static_cast<int>(static_cast<Machine*>(machine)->out_data.size());
+  return PD_NO_ERROR;
+}
+
+paddle_error paddle_tpu_machine_get_output(paddle_tpu_machine machine,
+                                           int idx, const float** data,
+                                           const int64_t** dims, int* ndim) {
+  if (machine == nullptr || data == nullptr || dims == nullptr ||
+      ndim == nullptr)
+    return PD_NULLPTR;
+  Machine* m = static_cast<Machine*>(machine);
+  if (idx < 0 || idx >= static_cast<int>(m->out_data.size()))
+    return PD_OUT_OF_RANGE;
+  *data = m->out_data[idx].data();
+  *dims = m->out_dims[idx].data();
+  *ndim = static_cast<int>(m->out_dims[idx].size());
+  return PD_NO_ERROR;
+}
+
+paddle_error paddle_tpu_machine_destroy(paddle_tpu_machine machine) {
+  if (machine == nullptr) return PD_NULLPTR;
+  Machine* m = static_cast<Machine*>(machine);
+  {
+    Gil gil;
+    Py_XDECREF(m->py_machine);
+  }
+  delete m;
+  return PD_NO_ERROR;
+}
+
+}  // extern "C"

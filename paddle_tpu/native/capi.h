@@ -1,0 +1,67 @@
+/* C inference API for the TPU-native framework.
+ *
+ * Parity surface for the reference's capi (reference:
+ * paddle/capi/gradient_machine.h:36 create_for_inference_with_parameters,
+ * :73 forward; paddle/capi/error.h paddle_error): load a model saved by
+ * fluid.io.save_inference_model and run forward passes from C/C++.
+ *
+ * The reference's C API fronts its C++ GradientMachine; here the runtime is
+ * the XLA executor, reached through an embedded CPython interpreter (the
+ * same embedding technique the reference uses for PyDataProvider2). The
+ * first call to paddle_tpu_init() boots the interpreter; model handles are
+ * opaque and thread-safe at the GIL's granularity.
+ */
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  PD_NO_ERROR = 0,
+  PD_NULLPTR = 1,
+  PD_OUT_OF_RANGE = 2,
+  PD_PROTOBUF_ERROR = 3,
+  PD_NOT_SUPPORTED = 4,
+  PD_UNDEFINED_ERROR = 5,
+} paddle_error;
+
+typedef void* paddle_tpu_machine;
+
+/* Boot the embedded interpreter (idempotent). Honors PYTHONPATH. */
+paddle_error paddle_tpu_init(void);
+
+/* Create an inference machine from a save_inference_model directory
+ * (reference create_for_inference_with_parameters semantics: topology +
+ * parameters in one artifact). */
+paddle_error paddle_tpu_machine_create(paddle_tpu_machine* machine,
+                                       const char* model_dir);
+
+/* Stage one named input (row-major float32). */
+paddle_error paddle_tpu_machine_set_input(paddle_tpu_machine machine,
+                                          const char* name,
+                                          const float* data,
+                                          const int64_t* dims, int ndim);
+
+/* Run the forward pass over the staged inputs
+ * (reference gradient_machine.h:73 forward, isTrain=false). */
+paddle_error paddle_tpu_machine_forward(paddle_tpu_machine machine);
+
+/* Number of fetch outputs of the loaded model. */
+paddle_error paddle_tpu_machine_output_count(paddle_tpu_machine machine,
+                                             int* count);
+
+/* Borrowed view of output `idx`; valid until the next forward/destroy. */
+paddle_error paddle_tpu_machine_get_output(paddle_tpu_machine machine,
+                                           int idx, const float** data,
+                                           const int64_t** dims, int* ndim);
+
+paddle_error paddle_tpu_machine_destroy(paddle_tpu_machine machine);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H */

@@ -270,7 +270,14 @@ def _gru(ctx, op_, ins):
     return {"Hidden": [hidden]}
 
 
-@op("lstm_unit", infer_shape=None)
+def _lstm_unit_infer(op_, block):
+    cv = in_var(op_, block, "C_prev")
+    if cv is not None and cv.shape is not None:
+        set_out(op_, block, "C", cv.shape, cv.dtype)
+        set_out(op_, block, "H", cv.shape, cv.dtype)
+
+
+@op("lstm_unit", infer_shape=_lstm_unit_infer)
 def _lstm_unit(ctx, op_, ins):
     """Single LSTM step (reference lstm_unit_op.cc): inputs X=[B,4H] gates
     (already x@W_x + h@W_h + b), C_prev=[B,H]; outputs C, H."""
@@ -285,7 +292,17 @@ def _lstm_unit(ctx, op_, ins):
     return {"C": [c], "H": [h]}
 
 
-@op("gru_unit", infer_shape=None)
+def _gru_unit_infer(op_, block):
+    hv = in_var(op_, block, "HiddenPrev")
+    iv = in_var(op_, block, "Input")
+    if hv is not None and hv.shape is not None:
+        set_out(op_, block, "Hidden", hv.shape, hv.dtype)
+        set_out(op_, block, "ResetHiddenPrev", hv.shape, hv.dtype)
+    if iv is not None and iv.shape is not None:
+        set_out(op_, block, "Gate", iv.shape, iv.dtype)
+
+
+@op("gru_unit", infer_shape=_gru_unit_infer)
 def _gru_unit(ctx, op_, ins):
     """Single GRU step (reference gru_unit_op.cc): Input=[B,3H] x-projection,
     HiddenPrev=[B,H], Weight=[H,3H], Bias=[1,3H]."""
@@ -552,21 +569,47 @@ def _sequence_erase(ctx, op_, ins):
 
 @op("lod_reset", infer_shape=None, non_diff_inputs=("Y",))
 def _lod_reset(ctx, op_, ins):
-    """Attach new sequence lengths to a tensor (reference lod_reset_op.cc).
-    target lengths from input Y (lengths/offsets tensor) or attr
-    target_lod (offsets)."""
+    """Re-partition a sequence batch under a new LoD (reference
+    lod_reset_op.cc). With a static attr target_lod the padded rows are
+    physically regrouped: valid rows compact to the front (stable sort on
+    the padding mask) and re-split by the new offsets — static output
+    shape, traced old lengths. With a traced Y offsets input only the
+    lengths channel changes (partitions must then be compatible with the
+    existing padding)."""
     x = jnp.asarray(ins["X"][0])
     if ins.get("Y") and ins["Y"][0] is not None:
         y = jnp.asarray(ins["Y"][0]).reshape(-1).astype(jnp.int32)
         lengths = y[1:] - y[:-1]   # offsets -> lengths
+        for name in op_.desc.outputs.get("Out", []):
+            ctx.set_seq_len(name, lengths)
+        return {"Out": [x]}
+    import numpy as _np
+    offs = _np.asarray(op_.attr("target_lod", []), dtype=_np.int32)
+    new_lens = offs[1:] - offs[:-1]
+    name_x = op_.desc.inputs["X"][0]
+    old = ctx.seq_len(name_x)
+    if x.ndim >= 2 and old is not None:
+        b, t = x.shape[0], x.shape[1]
+        valid = jnp.arange(t)[None, :] < jnp.asarray(old)[:, None]
+        flat_valid = valid.reshape(-1)
+        order = jnp.argsort(jnp.where(flat_valid, 0, 1), stable=True)
+        flat_rows = x.reshape((b * t,) + tuple(x.shape[2:]))[order]
+        b2, t2 = len(new_lens), int(new_lens.max()) if len(new_lens) else 1
+        idx = _np.zeros((b2, t2), dtype=_np.int32)
+        for i in range(b2):
+            for j in range(t2):
+                idx[i, j] = offs[i] + min(j, max(int(new_lens[i]) - 1, 0))
+        out = flat_rows[jnp.asarray(idx.reshape(-1))].reshape(
+            (b2, t2) + tuple(x.shape[2:]))
+        mask = (_np.arange(t2)[None, :] <
+                new_lens[:, None]).reshape((b2, t2) + (1,) * (x.ndim - 2))
+        out = out * jnp.asarray(mask, dtype=x.dtype)
     else:
-        target = op_.attr("target_lod", [])
-        import numpy as _np
-        offs = _np.asarray(target, dtype=_np.int32)
-        lengths = jnp.asarray(offs[1:] - offs[:-1])
+        out = x
+    lengths = jnp.asarray(new_lens)
     for name in op_.desc.outputs.get("Out", []):
         ctx.set_seq_len(name, lengths)
-    return {"Out": [x]}
+    return {"Out": [out]}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,74 @@
+"""C inference API: compile the shim + example and check C predictions match
+Python (reference: paddle/capi/gradient_machine.h, capi/examples)."""
+
+import os
+import re
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "paddle_tpu", "native")
+
+
+def _build():
+    r = subprocess.run(["make", "-s", "-C", NATIVE, "libpaddle_tpu_capi.so"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"capi build unavailable: {r.stderr[-500:]}")
+    r = subprocess.run(
+        ["gcc", os.path.join(REPO, "examples/capi/infer_fit_a_line.c"),
+         "-I", NATIVE, "-L", NATIVE, "-lpaddle_tpu_capi",
+         "-o", os.path.join(NATIVE, "infer_fit_a_line")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+class TestCAPI:
+    def test_c_matches_python(self, tmp_path):
+        _build()
+        # train + save a fit_a_line model
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        w = rng.randn(13, 1).astype(np.float32)
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(startup)
+            for _ in range(30):
+                xs = rng.randn(32, 13).astype(np.float32)
+                exe.run(main, feed={"x": xs, "y": xs @ w},
+                        fetch_list=[loss])
+            fluid.io.save_inference_model(str(tmp_path), ["x"], [pred], exe,
+                                          main_program=main)
+            # python-side predictions on the C example's fixed input
+            cx = np.array([[0.1 * 1 * j for j in range(13)],
+                           [0.1 * 2 * j for j in range(13)]], np.float32)
+            prog, feeds, fetches = fluid.io.load_inference_model(
+                str(tmp_path), exe)
+            want, = exe.run(prog, feed={"x": cx}, fetch_list=fetches)
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["LD_LIBRARY_PATH"] = NATIVE + os.pathsep + \
+            env.get("LD_LIBRARY_PATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        r = subprocess.run([os.path.join(NATIVE, "infer_fit_a_line"),
+                            str(tmp_path)],
+                           capture_output=True, text=True, env=env,
+                           timeout=300)
+        assert r.returncode == 0, (r.stdout, r.stderr[-1500:])
+        preds = [float(m) for m in
+                 re.findall(r"pred\[\d+\]=([-\d.]+)", r.stdout)]
+        assert len(preds) == 2
+        np.testing.assert_allclose(preds, np.asarray(want).reshape(-1),
+                                   rtol=1e-4, atol=1e-5)

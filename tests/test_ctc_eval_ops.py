@@ -328,3 +328,55 @@ class TestPositiveNegativePair:
         assert float(np.asarray(res["PositivePair"])) == pos
         assert float(np.asarray(res["NegativePair"])) == neg
         assert float(np.asarray(res["NeutralPair"])) == neu
+
+
+class TestEvaluatorsUnorphaned:
+    """metrics.ChunkEvaluator / EditDistance fed by their in-graph producer
+    ops across minibatches (previously API surface without a producing op)."""
+
+    def test_chunk_evaluator_accumulates(self):
+        from paddle_tpu.metrics import ChunkEvaluator
+        ev = ChunkEvaluator()
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            inf = fluid.layers.data(name="inf", shape=[1], dtype="int64",
+                                    lod_level=1)
+            lab = fluid.layers.data(name="lab", shape=[1], dtype="int64",
+                                    lod_level=1)
+            _, _, _, ni, nl, nc = fluid.layers.chunk_eval(
+                input=inf, label=lab, chunk_scheme="IOB", num_chunk_types=2)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                for _ in range(2):
+                    lab_rows = [np.array([[0], [1], [4], [2]], np.int64)]
+                    inf_rows = [np.array([[0], [1], [4], [0]], np.int64)]
+                    a, b, c = exe.run(
+                        fluid.default_main_program(),
+                        feed={"inf": make_lod(inf_rows),
+                              "lab": make_lod(lab_rows)},
+                        fetch_list=[ni, nl, nc])
+                    ev.update(a, b, c)
+        p, r, f1 = ev.eval()
+        assert (p, r, f1) == (0.5, 0.5, 0.5)
+
+    def test_edit_distance_metric(self):
+        from paddle_tpu.metrics import EditDistance as EDMetric
+        ev = EDMetric()
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            h = fluid.layers.data(name="h", shape=[1], dtype="int64",
+                                  lod_level=1)
+            r = fluid.layers.data(name="r", shape=[1], dtype="int64",
+                                  lod_level=1)
+            dist, seq_num = fluid.layers.edit_distance(h, r,
+                                                       normalized=False)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                d, n = exe.run(
+                    fluid.default_main_program(),
+                    feed={"h": make_lod([np.array([[1], [2]], np.int64),
+                                         np.array([[5]], np.int64)]),
+                          "r": make_lod([np.array([[1], [3]], np.int64),
+                                         np.array([[5]], np.int64)])},
+                    fetch_list=[dist, seq_num])
+                ev.update(d, n)
+        avg, instance_err = ev.eval()
+        assert abs(avg - 0.5) < 1e-6          # distances [1, 0] over 2 seqs

@@ -27,8 +27,7 @@ def _train_once(is_sparse, steps=3):
         fluid.optimizer.SGDOptimizer(learning_rate=0.5).minimize(loss)
     exe = fluid.Executor(fluid.CPUPlace())
     scope = executor_mod.Scope()
-    feed = {"ids": RNG.RandomState if False else np.array(
-                [[1, 7, 7, 3], [0, 2, 2, 2]], np.int64),
+    feed = {"ids": np.array([[1, 7, 7, 3], [0, 2, 2, 2]], np.int64),
             "lbl": np.array([[5], [9]], np.int64)}
     with executor_mod.scope_guard(scope):
         exe.run(startup)

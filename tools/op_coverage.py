@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Op-coverage report: which registered ops does the test suite execute?
+
+Usage:
+    rm -f /tmp/op_coverage.txt
+    PADDLE_TPU_RECORD_OPS=/tmp/op_coverage.txt python -m pytest tests/ -q
+    python tools/op_coverage.py /tmp/op_coverage.txt
+
+(reference test discipline: tests/unittests has one OpTest file per op —
+op_test.py:212; this report proves the same property for the new corpus.)
+"""
+
+import sys
+
+
+def main(path):
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu  # noqa: F401  (registers all ops)
+    from paddle_tpu.ops import registry
+
+    executed = set()
+    with open(path) as f:
+        for line in f:
+            executed.add(line.strip())
+    registered = set(registry.registered_ops())
+    # executor-level ops with no kernel of their own
+    structural = {"feed", "fetch"}
+    covered = sorted(registered & executed)
+    missing = sorted(registered - executed - structural)
+    grad_only = sorted(e for e in executed if e.endswith("_grad")
+                       and e not in registered)
+    print(f"registered ops : {len(registered)}")
+    print(f"executed       : {len(covered)} "
+          f"(+{len(grad_only)} auto-generated grad ops)")
+    print(f"missing        : {len(missing)}")
+    for m in missing:
+        print(f"  UNCOVERED {m}")
+    return 1 if missing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/op_coverage.txt"))
