@@ -734,7 +734,6 @@ class Executor:
             # the reference's pserver/NCCL paths (SURVEY.md §2.5).
             from jax.sharding import NamedSharding, PartitionSpec
             repl = NamedSharding(mesh, PartitionSpec())
-            dp = mesh.axis_names[0]
 
             # per-parameter PartitionSpec annotations (tensor / ZeRO
             # sharding, parallel/tensor_parallel.py); unannotated state is
@@ -745,13 +744,39 @@ class Executor:
                 state_shardings[n] = repl if spec is None else \
                     NamedSharding(mesh, PartitionSpec(*spec))
 
+            # Feed sharding rule: an explicit per-feed override
+            # (program._feed_shardings[name] = spec tuple, see
+            # parallel.shard_feed) wins; otherwise feeds batch-shard on
+            # the axis named 'dp' when the mesh has one, and replicate on
+            # meshes without a data axis (sp/ep/mp-only meshes must opt
+            # in via shard_feed). @SEQLEN sidecars are [batch] vectors
+            # and follow their base feed's batch (dim-0) axis.
+            feed_specs = getattr(program, "_feed_shardings", {})
+            dp_axis = "dp" if "dp" in mesh.axis_names else None
+            default = NamedSharding(mesh, PartitionSpec(dp_axis)) \
+                if dp_axis else repl
+
+            def _feed_sharding(n):
+                if n.endswith(SEQLEN2_SUFFIX):
+                    base = n[: -len(SEQLEN2_SUFFIX)]
+                elif n.endswith(SEQLEN_SUFFIX):
+                    base = n[: -len(SEQLEN_SUFFIX)]
+                else:
+                    base = None
+                if base is not None:
+                    bspec = feed_specs.get(base)
+                    if bspec is not None:
+                        return NamedSharding(mesh, PartitionSpec(bspec[0]))
+                    return default
+                spec = feed_specs.get(n)
+                if spec is not None:
+                    return NamedSharding(mesh, PartitionSpec(*spec))
+                return default
+
+            feed_shardings = {n: _feed_sharding(n) for n in feed_names}
             jitted = jax.jit(
                 fn, donate_argnums=(1,),
-                in_shardings=(
-                    {n: NamedSharding(
-                        mesh, PartitionSpec(dp)) for n in feed_names},
-                    state_shardings,
-                    repl))
+                in_shardings=(feed_shardings, state_shardings, repl))
         else:
             jitted = jax.jit(fn, donate_argnums=(1,))
         return _CompiledBlock(jitted, state_names, feed_names, fetch_names,

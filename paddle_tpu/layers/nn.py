@@ -31,6 +31,7 @@ __all__ = [
     "precision_recall", "positive_negative_pair", "pool3d", "roi_pool",
     "prelu", "crop", "spp", "unpool", "conv3d_transpose",
     "max_pool2d_with_index", "conv_shift", "l1_norm",
+    "scaled_dot_product_attention", "sparse_moe",
 ]
 
 
@@ -1039,4 +1040,55 @@ def l1_norm(x, name=None):
     out = helper.create_tmp_variable(x.dtype)
     helper.append_op(type="l1_norm", inputs={"X": [x]},
                      outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def scaled_dot_product_attention(q, k, v, causal=False,
+                                 sequence_parallel=False, name=None):
+    """Fused attention over [B, T, H, D] tensors; sequence_parallel=True
+    runs ring attention over the program mesh's 'sp' axis
+    (parallel/ring_attention.py) for long-context training."""
+    helper = LayerHelper("scaled_dot_product_attention")
+    out = helper.create_tmp_variable(q.dtype)
+    helper.append_op(type="scaled_dot_product_attention",
+                     inputs={"Q": [q], "K": [k], "V": [v]},
+                     outputs={"Out": [out]},
+                     attrs={"causal": causal,
+                            "sequence_parallel": sequence_parallel})
+    return out
+
+
+def sparse_moe(x, num_experts, hidden_size, capacity_factor=1.25,
+               param_attr=None, name=None):
+    """Top-1 gated mixture-of-experts FFN over [N, D] tokens (GShard-style
+    dispatch; see ops/nn_ops.py moe_ffn). Shard the returned layer's W1/W2
+    over an 'ep' mesh axis with parallel.shard_parameter for expert
+    parallelism."""
+    helper = LayerHelper("sparse_moe", param_attr=param_attr)
+    d = x.shape[-1]
+    # one ParamAttr instance per parameter: create_parameter binds the
+    # attr's name, so sharing one attr across gate/W1/W2 would collide
+    import copy as _copy
+
+    def _attr(suffix):
+        a = helper.param_attr
+        a = _copy.deepcopy(a)
+        if getattr(a, "name", None):
+            a.name = f"{a.name}.{suffix}"
+        return a
+
+    gate_w = helper.create_parameter(attr=_attr("gate"),
+                                     shape=[d, num_experts], dtype=x.dtype)
+    w1 = helper.create_parameter(attr=_attr("w1"),
+                                 shape=[num_experts, d, hidden_size],
+                                 dtype=x.dtype)
+    w2 = helper.create_parameter(attr=_attr("w2"),
+                                 shape=[num_experts, hidden_size, d],
+                                 dtype=x.dtype)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="moe_ffn",
+                     inputs={"X": [x], "GateW": [gate_w],
+                             "W1": [w1], "W2": [w2]},
+                     outputs={"Out": [out]},
+                     attrs={"capacity_factor": capacity_factor})
     return out

@@ -605,3 +605,91 @@ def _auc(ctx, op_, ins):
     fpr = fp / N
     auc = -jnp.trapezoid(tpr, fpr)
     return {"AUC": [auc.reshape(1)]}
+
+
+# --- attention ---------------------------------------------------------------
+
+def _sdpa_infer(op_, block):
+    qv = in_var(op_, block, "Q")
+    if qv is not None:
+        set_out(op_, block, "Out", qv.shape, qv.dtype)
+
+
+@op("scaled_dot_product_attention", infer_shape=_sdpa_infer)
+def _scaled_dot_product_attention(ctx, op_, ins):
+    """Fused softmax attention, Q/K/V [B, T, H, D] (no 2018-reference
+    analogue — the capability the brief requires for long context). With
+    sequence_parallel=True and a program mesh carrying an 'sp' axis, the
+    computation runs as ring attention (parallel/ring_attention.py):
+    sequence shards stay resident per device and K/V rotate over ICI via
+    ppermute, so full-sequence scores never materialize."""
+    q = jnp.asarray(ins["Q"][0])
+    k = jnp.asarray(ins["K"][0])
+    v = jnp.asarray(ins["V"][0])
+    causal = op_.attr("causal", False)
+    (q, k, v), restore = mxu_cast(ctx, q, k, v)
+    from ..parallel.ring_attention import (attention_reference,
+                                           ring_attention_sharded)
+    mesh = getattr(ctx.program, "_mesh", None)
+    if op_.attr("sequence_parallel", False) and mesh is not None and \
+            "sp" in mesh.axis_names:
+        out = ring_attention_sharded(q, k, v, mesh, axis="sp",
+                                     causal=causal)
+    else:
+        out = attention_reference(q, k, v, causal=causal)
+    if restore is not None:
+        out = out.astype(restore)
+    return {"Out": [out]}
+
+
+# --- mixture of experts ------------------------------------------------------
+
+def _moe_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None:
+        set_out(op_, block, "Out", xv.shape, xv.dtype)
+
+
+@op("moe_ffn", infer_shape=_moe_infer)
+def _moe_ffn(ctx, op_, ins):
+    """Top-1 gated mixture-of-experts FFN in the GShard dispatch-einsum
+    form (no 2018-reference analogue; the expert-parallel capability the
+    brief requires). Tokens route to their top expert up to a fixed
+    capacity C = ceil(N/E * capacity_factor); dispatch/combine are one-hot
+    einsums, so when the expert weights W1 [E, D, F] / W2 [E, F, D] are
+    sharded over an 'ep' mesh axis (parallel.shard_parameter), GSPMD
+    partitions the expert matmuls and inserts the token all-to-all over
+    ICI. Overflowed tokens pass through (residual), standard MoE practice.
+    """
+    x = jnp.asarray(ins["X"][0])              # [N, D]
+    gw = jnp.asarray(ins["GateW"][0])         # [D, E]
+    w1 = jnp.asarray(ins["W1"][0])            # [E, D, F]
+    w2 = jnp.asarray(ins["W2"][0])            # [E, F, D]
+    (x, gw, w1, w2), restore = mxu_cast(ctx, x, gw, w1, w2)
+    n, d = x.shape
+    e = w1.shape[0]
+    cap_f = op_.attr("capacity_factor", 1.25)
+    cap = max(int(np.ceil(n / e * cap_f)), 1)
+
+    logits = x @ gw                            # [N, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top = jnp.argmax(probs, axis=-1)           # [N]
+    top_p = jnp.max(probs, axis=-1)            # [N]
+    onehot = jax.nn.one_hot(top, e, dtype=jnp.float32)   # [N, E]
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot    # position in expert
+    keep = (pos < cap) & (onehot > 0)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                            dtype=jnp.float32) * keep[..., None]
+    dispatch = pos_oh                           # [N, E, C]
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, w1))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2)
+    combine = dispatch * top_p[:, None, None].astype(jnp.float32)
+    routed = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), expert_out)
+    # overflowed / unrouted tokens pass through unchanged
+    routed_mask = dispatch.sum(axis=(1, 2)).astype(x.dtype)[:, None]
+    out = routed + x * (1.0 - routed_mask)
+    if restore is not None:
+        out = out.astype(restore)
+    return {"Out": [out]}

@@ -8,3 +8,15 @@ from . import multihost
 from . import tensor_parallel
 from .tensor_parallel import (shard_parameter, shard_fc_params,
                               shard_all_params_zero)
+from . import ring_attention
+from .ring_attention import ring_attention_sharded
+
+
+def shard_feed(program, name, spec):
+    """Override a feed variable's mesh sharding (dims -> axis name or
+    None), e.g. shard_feed(prog, "tokens", (None, "sp")) to split the
+    sequence axis for ring attention."""
+    if not hasattr(program, "_feed_shardings"):
+        program._feed_shardings = {}
+    program._feed_shardings[name] = tuple(spec)
+    return program

@@ -1,0 +1,135 @@
+"""Ring attention: sequence/context parallelism over a device mesh.
+
+Long-context capability the 2018 reference lacks entirely (its sequence
+story is LoD packing, SURVEY.md §2.5 last row); on TPU the natural design
+is the ring schedule (Liu et al., Ring Attention; the 'How to Scale Your
+Model' collective recipe): shard the sequence axis over an 'sp' mesh axis,
+keep Q resident, and rotate K/V shards around the ring with
+`lax.ppermute` while accumulating attention in the numerically stable
+online-softmax (flash) form. Peak memory per device is O(T/P) sequence
+and O(T/P * T/P) scores — full-sequence attention never materializes —
+and the K/V rotation rides ICI concurrently with compute.
+
+Everything is pure differentiable JAX: `ppermute` has a transpose rule,
+so `jax.grad` of the ring matches the single-device attention gradient
+(tested to 1e-5 on an 8-device host mesh)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["attention_reference", "ring_attention", "ring_attention_sharded"]
+
+
+def attention_reference(q, k, v, causal: bool = False, scale=None):
+    """Plain softmax attention, q/k/v [B, T, H, D] -> [B, T, H, D]."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_attn(q, k, v, scale, mask):
+    """Unnormalized blockwise attention: returns (acc, row_sum, row_max)
+    in the online-softmax form. q [B,Tq,H,D], k/v [B,Tk,H,D],
+    mask [Tq,Tk] bool or None."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1)                      # [B,H,Tq]
+    # all-masked rows produce -inf max; exp(-inf - -inf) would NaN
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])           # [B,H,Tq,Tk]
+    l = jnp.sum(p, axis=-1)                           # [B,H,Tq]
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return acc, l, m_safe, jnp.isfinite(m)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale=None):
+    """Attention over a sequence sharded on `axis_name` (call inside
+    shard_map / pjit with that axis). q/k/v are the LOCAL shards
+    [B, T/P, H, D]; returns the local output shard.
+
+    Each of the P ring steps attends the resident Q against the visiting
+    K/V shard and merges via online softmax; `ppermute` then rotates the
+    K/V shard (and its global offset) one hop — on hardware meshes the
+    send overlaps the next block's compute on ICI."""
+    p_size = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+
+    q_pos = idx * t_local + jnp.arange(t_local)       # global q positions
+
+    def step(carry, _):
+        k_cur, v_cur, k_off, acc, l_acc, m_acc, any_valid = carry
+        if causal:
+            kv_pos = k_off + jnp.arange(t_local)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+        else:
+            mask = None
+        acc_b, l_b, m_b, valid_b = _block_attn(q, k_cur, v_cur, scale, mask)
+        # online-softmax merge of (acc, l, m) with the new block
+        m_new = jnp.maximum(m_acc, m_b)
+        alpha = jnp.exp(m_acc - m_new)                # rescale old
+        beta = jnp.exp(m_b - m_new)                   # rescale new
+        # blocks with no valid entries must not contribute
+        beta = jnp.where(valid_b, beta, 0.0)
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
+            acc_b * beta.transpose(0, 2, 1)[..., None]
+        l_acc = l_acc * alpha + l_b * beta
+        m_acc = m_new
+        any_valid = any_valid | valid_b
+
+        perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        off_nxt = lax.ppermute(k_off, axis_name, perm)
+        return (k_nxt, v_nxt, off_nxt, acc, l_acc, m_acc, any_valid), None
+
+    def _vary(x):
+        # newer jax (jax.shard_map) type-checks varying-manifest axes on
+        # scan carries; replicated-initialized carries must be marked
+        # varying over the ring axis explicitly
+        pv = getattr(lax, "pvary", None)
+        return pv(x, (axis_name,)) if pv is not None else x
+
+    acc0 = _vary(jnp.zeros((b, t_local, h, d), q.dtype))
+    l0 = _vary(jnp.zeros((b, h, t_local), q.dtype))
+    m0 = _vary(jnp.full((b, h, t_local), -jnp.inf, q.dtype))
+    valid0 = _vary(jnp.zeros((b, h, t_local), bool))
+    k_off0 = idx * t_local
+    (_, _, _, acc, l_acc, _, _), _ = lax.scan(
+        step, (k, v, k_off0, acc0, l0, m0, valid0), None, length=p_size)
+    return acc / jnp.maximum(l_acc, 1e-30).transpose(0, 2, 1)[..., None]
+
+
+def ring_attention_sharded(q, k, v, mesh, axis: str = "sp",
+                           causal: bool = False):
+    """Convenience wrapper: global q/k/v [B, T, H, D] -> shard_map the ring
+    over mesh axis `axis` (T must divide by the axis size)."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:              # older jax
+        from jax.experimental.shard_map import shard_map
+
+    spec = P(None, axis, None, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    def run(ql, kl, vl):
+        return ring_attention(ql, kl, vl, axis_name=axis, causal=causal)
+
+    return run(q, k, v)

@@ -1,0 +1,97 @@
+"""Ring attention (sequence/context parallelism) vs single-device oracle
+on the 8-device host mesh — forward and gradients, causal and full."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import mesh as mesh_mod
+from paddle_tpu.parallel.ring_attention import (attention_reference,
+                                                ring_attention_sharded)
+
+RNG = np.random.RandomState(13)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return mesh_mod.make_mesh((8,), ("sp",))
+
+
+def _qkv(b=2, t=32, h=2, d=8):
+    q = RNG.randn(b, t, h, d).astype(np.float32)
+    k = RNG.randn(b, t, h, d).astype(np.float32)
+    v = RNG.randn(b, t, h, d).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, mesh, causal):
+        q, k, v = _qkv()
+        want = attention_reference(q, k, v, causal=causal)
+        got = ring_attention_sharded(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match(self, mesh, causal):
+        q, k, v = _qkv(b=1, t=16, h=1, d=4)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(
+                ring_attention_sharded(q, k, v, mesh, causal=causal) ** 2)
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-5, atol=5e-6)
+
+    def test_long_sequence_never_materializes_full_scores(self, mesh):
+        """Smoke at a length where full [T, T] scores would be 64x the
+        per-shard block: just asserts the sharded form runs and matches."""
+        q, k, v = _qkv(b=1, t=256, h=1, d=8)
+        want = attention_reference(q, k, v, causal=True)
+        got = ring_attention_sharded(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=5e-5, atol=5e-6)
+
+
+class TestAttentionOpInProgram:
+    def _run(self, mesh, seq_par):
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod
+        local = np.random.RandomState(77)
+        q_np = local.randn(2, 32, 2, 8).astype(np.float32)
+        k_np = local.randn(2, 32, 2, 8).astype(np.float32)
+        v_np = local.randn(2, 32, 2, 8).astype(np.float32)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            q = fluid.layers.data(name="q", shape=[2, 32, 2, 8],
+                                  dtype="float32", append_batch_size=False)
+            k = fluid.layers.data(name="k", shape=[2, 32, 2, 8],
+                                  dtype="float32", append_batch_size=False)
+            v = fluid.layers.data(name="v", shape=[2, 32, 2, 8],
+                                  dtype="float32", append_batch_size=False)
+            out = fluid.layers.scaled_dot_product_attention(
+                q, k, v, causal=True, sequence_parallel=seq_par)
+        if mesh is not None:
+            main._mesh = mesh
+            for n in ("q", "k", "v"):
+                fluid.parallel.shard_feed(main, n, (None, "sp", None, None))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            got, = exe.run(main, feed={"q": q_np, "k": k_np, "v": v_np},
+                           fetch_list=[out])
+        return np.asarray(got)
+
+    def test_program_level_ring_matches_single(self):
+        import paddle_tpu as fluid
+        from paddle_tpu.parallel import mesh as mesh_mod
+        single = self._run(None, False)
+        ring = self._run(mesh_mod.make_mesh((8,), ("sp",)), True)
+        np.testing.assert_allclose(ring, single, rtol=2e-5, atol=2e-6)
