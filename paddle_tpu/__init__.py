@@ -38,6 +38,7 @@ from . import reader
 from . import dataset
 from .minibatch import batch
 from . import parallel
+from . import debugger
 from . import profiler
 from . import amp
 from . import compat

@@ -766,7 +766,8 @@ class Executor:
                 if base is not None:
                     bspec = feed_specs.get(base)
                     if bspec is not None:
-                        return NamedSharding(mesh, PartitionSpec(bspec[0]))
+                        return NamedSharding(mesh, PartitionSpec(
+                            bspec[0] if bspec else None))
                     return default
                 spec = feed_specs.get(n)
                 if spec is not None:

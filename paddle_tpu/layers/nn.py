@@ -31,7 +31,7 @@ __all__ = [
     "precision_recall", "positive_negative_pair", "pool3d", "roi_pool",
     "prelu", "crop", "spp", "unpool", "conv3d_transpose",
     "max_pool2d_with_index", "conv_shift", "l1_norm",
-    "scaled_dot_product_attention", "sparse_moe",
+    "fused_attention", "sparse_moe",
 ]
 
 
@@ -1043,12 +1043,15 @@ def l1_norm(x, name=None):
     return out
 
 
-def scaled_dot_product_attention(q, k, v, causal=False,
-                                 sequence_parallel=False, name=None):
+def fused_attention(q, k, v, causal=False,
+                    sequence_parallel=False, name=None):
     """Fused attention over [B, T, H, D] tensors; sequence_parallel=True
     runs ring attention over the program mesh's 'sp' axis
-    (parallel/ring_attention.py) for long-context training."""
-    helper = LayerHelper("scaled_dot_product_attention")
+    (parallel/ring_attention.py) for long-context training. (Named
+    fused_attention because reference-parity
+    nets.scaled_dot_product_attention already takes [B, T, D] with
+    num_heads and different semantics.)"""
+    helper = LayerHelper("fused_attention")
     out = helper.create_tmp_variable(q.dtype)
     helper.append_op(type="scaled_dot_product_attention",
                      inputs={"Q": [q], "K": [k], "V": [v]},

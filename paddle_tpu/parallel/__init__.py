@@ -9,6 +9,8 @@ from . import tensor_parallel
 from .tensor_parallel import (shard_parameter, shard_fc_params,
                               shard_all_params_zero)
 from . import ring_attention
+from . import pipeline
+from .pipeline import gpipe
 from .ring_attention import ring_attention_sharded
 
 

@@ -1,0 +1,27 @@
+"""Shared helpers for shard_map-based collectives."""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def mark_varying(x, axis_name: str):
+    """Mark a replicated value as varying over `axis_name` for shard_map's
+    varying-manifest-axis typechecker (scan carries initialized from
+    replicated constants need this). Tries the current API first and
+    degrades gracefully on jax versions without one."""
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        try:
+            return pcast(x, (axis_name,), to="varying")
+        except TypeError:
+            pass
+    pvary = getattr(lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, (axis_name,))
+    return x
+
+
+def tree_mark_varying(tree, axis_name: str):
+    return jax.tree_util.tree_map(lambda a: mark_varying(a, axis_name), tree)

@@ -80,8 +80,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         else:
             mask = None
         acc_b, l_b, m_b, valid_b = _block_attn(q, k_cur, v_cur, scale, mask)
-        # online-softmax merge of (acc, l, m) with the new block
-        m_new = jnp.maximum(m_acc, m_b)
+        # online-softmax merge of (acc, l, m) with the new block. Rows the
+        # visiting block fully masks must not move the running max (their
+        # clamped m_b of 0.0 would destroy the subtraction invariant when
+        # the true row max is negative).
+        m_new = jnp.where(valid_b, jnp.maximum(m_acc, m_b), m_acc)
         alpha = jnp.exp(m_acc - m_new)                # rescale old
         beta = jnp.exp(m_b - m_new)                   # rescale new
         # blocks with no valid entries must not contribute
@@ -98,12 +101,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         off_nxt = lax.ppermute(k_off, axis_name, perm)
         return (k_nxt, v_nxt, off_nxt, acc, l_acc, m_acc, any_valid), None
 
+    from ._collectives import mark_varying
+
     def _vary(x):
-        # newer jax (jax.shard_map) type-checks varying-manifest axes on
-        # scan carries; replicated-initialized carries must be marked
-        # varying over the ring axis explicitly
-        pv = getattr(lax, "pvary", None)
-        return pv(x, (axis_name,)) if pv is not None else x
+        # shard_map type-checks varying-manifest axes on scan carries;
+        # replicated-initialized carries must be marked varying explicitly
+        return mark_varying(x, axis_name)
 
     acc0 = _vary(jnp.zeros((b, t_local, h, d), q.dtype))
     l0 = _vary(jnp.zeros((b, h, t_local), q.dtype))

@@ -1,0 +1,37 @@
+"""Program pretty printer + graphviz rendering (reference: debuger.py,
+test_debugger.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _toy_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main
+
+
+class TestDebugger:
+    def test_pprint_covers_ops_and_vars(self):
+        main = _toy_program()
+        out = []
+        fluid.debugger.pprint_program(main, print_fn=out.append)
+        text = "\n".join(out)
+        assert "mul(" in text and "sgd(" in text
+        assert "var x: float32" in text
+        assert "persistable" in text        # parameters marked
+
+    def test_draw_program_dot(self, tmp_path):
+        main = _toy_program()
+        path = str(tmp_path / "prog.dot")
+        dot = fluid.debugger.draw_program(main, path=path, render=False)
+        assert dot.startswith("digraph")
+        assert 'label="mul"' in dot and 'label="sgd"' in dot
+        assert "#c9e4ca" in dot             # parameter highlight present
+        assert (tmp_path / "prog.dot").exists()

@@ -77,7 +77,7 @@ class TestAttentionOpInProgram:
                                   dtype="float32", append_batch_size=False)
             v = fluid.layers.data(name="v", shape=[2, 32, 2, 8],
                                   dtype="float32", append_batch_size=False)
-            out = fluid.layers.scaled_dot_product_attention(
+            out = fluid.layers.fused_attention(
                 q, k, v, causal=True, sequence_parallel=seq_par)
         if mesh is not None:
             main._mesh = mesh
@@ -95,3 +95,18 @@ class TestAttentionOpInProgram:
         single = self._run(None, False)
         ring = self._run(mesh_mod.make_mesh((8,), ("sp",)), True)
         np.testing.assert_allclose(ring, single, rtol=2e-5, atol=2e-6)
+
+
+class TestRingAttentionNegativeLogits:
+    def test_strongly_negative_scores_causal(self, mesh):
+        """Regression: a later fully-masked visiting block must not reset
+        the running max to 0 when all true logits are very negative."""
+        local = np.random.RandomState(99)
+        q = jnp.asarray(local.randn(1, 16, 1, 4).astype(np.float32)) * 10.0
+        k = -q  # q·k strongly negative everywhere
+        v = jnp.asarray(local.randn(1, 16, 1, 4).astype(np.float32))
+        want = attention_reference(q, k, v, causal=True)
+        got = ring_attention_sharded(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+        assert not np.allclose(np.asarray(got), 0.0)
