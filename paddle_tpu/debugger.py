@@ -92,7 +92,7 @@ def draw_program(program, path: Optional[str] = None, block_idx: int = 0,
         with open(path, "w") as f:
             f.write(dot)
         if render and shutil.which("dot"):
-            for fmt in ("pdf",):
+            for fmt in ("pdf", "png"):
                 subprocess.run(["dot", f"-T{fmt}", path, "-o",
                                 f"{path}.{fmt}"], check=False,
                                capture_output=True)

@@ -451,9 +451,16 @@ class Executor:
                                          fetch_names, persist_out, lod_map)
                 if use_program_cache:
                     self._cache[key] = compiled
+            from . import profiler as profiler_mod
             with jax.default_device(self.device):
-                fetch_vals, fetch_lens, new_state = compiled.fn(
-                    feed_vals, state_vals, rng_key)
+                with profiler_mod.record("executor_run(jit)"):
+                    fetch_vals, fetch_lens, new_state = compiled.fn(
+                        feed_vals, state_vals, rng_key)
+                    if profiler_mod.is_active():
+                        # async dispatch returns futures; force execution
+                        # inside the timed scope so the event measures the
+                        # step, not the enqueue (only when profiling)
+                        jax.block_until_ready((fetch_vals, new_state))
             if _CHECK_NAN_INF:
                 # jit-path equivalent of the reference FLAGS_check_nan_inf
                 # per-op scan (executor.cc:325-333): inside one fused XLA
@@ -790,8 +797,12 @@ class Executor:
         env.update({k: jnp.asarray(v) for k, v in feed_vals.items()})
         ctx = LoweringContext(self, program, rng_key, lod_map)
         block = program.global_block()
+        from . import profiler as profiler_mod
         for op in block.ops:
-            self._exec_op(ctx, op, env)
+            # per-op host events in the interpreter path (reference
+            # RecordEvent around each kernel launch, operator.cc:486)
+            with profiler_mod.record(op.type):
+                self._exec_op(ctx, op, env)
             if _CHECK_NAN_INF:
                 for name in op.output_arg_names:
                     v = env.get(name)

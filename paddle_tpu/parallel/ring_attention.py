@@ -86,9 +86,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         # the true row max is negative).
         m_new = jnp.where(valid_b, jnp.maximum(m_acc, m_b), m_acc)
         alpha = jnp.exp(m_acc - m_new)                # rescale old
-        beta = jnp.exp(m_b - m_new)                   # rescale new
-        # blocks with no valid entries must not contribute
-        beta = jnp.where(valid_b, beta, 0.0)
+        # invalid rows must not contribute: mask the EXPONENT (exp(-inf)=0)
+        # rather than the value — where(valid, exp(big), 0) would still
+        # compute an inf whose where-VJP yields 0*inf = NaN gradients
+        beta = jnp.exp(jnp.where(valid_b, m_b - m_new, -jnp.inf))
         acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
             acc_b * beta.transpose(0, 2, 1)[..., None]
         l_acc = l_acc * alpha + l_b * beta

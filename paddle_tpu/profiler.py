@@ -15,28 +15,58 @@ from typing import Dict, List, Optional
 import jax
 
 __all__ = ["cuda_profiler", "profiler", "start_profiler", "stop_profiler",
-           "reset_profiler"]
+           "reset_profiler", "export_chrome_trace"]
 
 _events: Dict[str, List[float]] = defaultdict(list)
+# (name, start_ts, duration) triples for the chrome-trace export
+# (reference tools/timeline.py:31 merges host+device events the same way)
+_timeline: List = []
 _active = False
+_epoch = time.perf_counter()
 
 
-def record_event(name: str, seconds: float):
+def record_event(name: str, seconds: float, start: Optional[float] = None):
     if _active:
         _events[name].append(seconds)
+        if start is not None:
+            _timeline.append((name, start - _epoch, seconds))
 
 
 @contextlib.contextmanager
 def record(name: str):
+    if not _active:      # keep the interpreter hot path overhead-free
+        yield
+        return
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        record_event(name, time.perf_counter() - t0)
+        record_event(name, time.perf_counter() - t0, start=t0)
+
+
+def is_active() -> bool:
+    return _active
 
 
 def reset_profiler():
     _events.clear()
+    _timeline.clear()
+
+
+def export_chrome_trace(path: str):
+    """Write recorded host events as a Chrome tracing JSON (chrome://tracing
+    / Perfetto), the host half of the reference's timeline.py:31 output.
+    Device-side kernels live in the TensorBoard trace captured by
+    profiler(trace_dir=...) — point Perfetto at both for the merged view."""
+    import json
+    events = [{"name": name, "ph": "X", "pid": 0, "tid": 0,
+               "ts": start * 1e6, "dur": dur * 1e6,
+               "cat": "host"}
+              for name, start, dur in _timeline]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return path
 
 
 def start_profiler(state="All", trace_dir: Optional[str] = None):

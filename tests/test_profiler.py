@@ -1,0 +1,47 @@
+"""Profiler: host event table, per-op eager events, chrome-trace export
+(reference: profiler.py:76, platform/profiler.h, tools/timeline.py:31,
+test_profiler.py)."""
+
+import json
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu import profiler
+
+
+class TestProfiler:
+    def _run_once(self, use_jit):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.fc(input=x, size=3)
+            out = fluid.layers.reduce_sum(y)
+            exe = fluid.Executor(fluid.CPUPlace())
+            with executor_mod.scope_guard(executor_mod.Scope()):
+                exe.run(fluid.default_startup_program())
+                exe.run(fluid.default_main_program(),
+                        feed={"x": np.zeros((2, 4), np.float32)},
+                        fetch_list=[out], use_jit=use_jit)
+
+    def test_jit_run_records_block_event(self, capsys, tmp_path):
+        profiler.reset_profiler()
+        with profiler.profiler("All", sorted_key="total"):
+            self._run_once(use_jit=True)
+        captured = capsys.readouterr().out
+        assert "executor_run(jit)" in captured
+
+        trace = str(tmp_path / "trace.json")
+        profiler.export_chrome_trace(trace)
+        data = json.load(open(trace))
+        names = {e["name"] for e in data["traceEvents"]}
+        assert "executor_run(jit)" in names
+        assert all(e["ph"] == "X" and e["dur"] >= 0
+                   for e in data["traceEvents"])
+
+    def test_eager_run_records_per_op_events(self, capsys):
+        profiler.reset_profiler()
+        with profiler.profiler("All"):
+            self._run_once(use_jit=False)
+        captured = capsys.readouterr().out
+        assert "mul" in captured and "reduce_sum" in captured
