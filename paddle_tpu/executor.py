@@ -205,6 +205,20 @@ class LoweringContext:
         # out var name -> lengths array (or None to clear) set by sequence
         # lowerings to override the default SEQLEN propagation in _exec_op
         self.seq_overrides: Dict[str, Any] = {}
+        # internal activation-layout tags (ops/layout.py): var name ->
+        # "NHWC"/"NDHWC" for values held in the TPU-preferred layout;
+        # absent = canonical NCHW. Aware lowerings set tags for their
+        # outputs via set_layout (collected per-op like seq_overrides).
+        from .ops import layout as layout_mod
+        self.layout_opt = layout_mod.LAYOUT_OPT
+        self.layouts: Dict[str, str] = {}
+        self.layout_overrides: Dict[str, Any] = {}
+
+    def layout_of(self, name: str):
+        return self.layouts.get(name)
+
+    def set_layout(self, name: str, tag):
+        self.layout_overrides[name] = tag
 
     def seq_len(self, name: str):
         """Per-sequence valid lengths [batch] for a padded sequence var, or
@@ -365,6 +379,7 @@ class Executor:
         self.place = place if place is not None else TPUPlace(0)
         self.device = place_device(self.place)
         self._cache: Dict[Tuple, _CompiledBlock] = {}
+        self._analysis_cache: Dict[Tuple, Tuple] = {}
 
     # --- public API ---------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed: Optional[Dict] = None,
@@ -409,8 +424,8 @@ class Executor:
                     val, jax.Array) else val
 
         block = program.global_block()
-        state_names = self._external_inputs(program, block, set(feed_vals), scope)
-        persist_out = self._persistable_outputs(program, block)
+        state_names = self._external_inputs(program, set(feed_vals), scope)
+        persist_out = self._persistable_outputs(program)
 
         missing = [n for n in state_names if scope.find_var(n) is None]
         if missing:
@@ -498,6 +513,12 @@ class Executor:
         for n, v in zip(fetch_names, fetch_vals):
             lens = fetch_lens.get(n)
             inner = fetch_lens.get(n + SEQLEN2_SUFFIX)
+            if lens is None and not return_numpy:
+                # keep the fetch on-device: np.asarray would force a
+                # device->host sync per step, which return_numpy=False
+                # callers (benchmarks, pipelined training loops) avoid
+                rebuilt.append(v)
+                continue
             arr = np.asarray(v)
             if lens is not None:
                 lens = np.asarray(lens)
@@ -520,6 +541,7 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._analysis_cache.clear()
 
     # --- analysis -----------------------------------------------------------
     @staticmethod
@@ -544,33 +566,46 @@ class Executor:
                 produced.add(name)
                 writes.add(name)
 
-    def _external_inputs(self, program, block, fed: set, scope) -> List[str]:
+    def _analysis(self, program):
+        """Per-(program, version) cached read/write sets + persistable map.
+        The full block walk costs milliseconds on a ResNet-scale program
+        and used to run twice per Executor.run — at TPU step rates that
+        was a measurable host-side stall between steps."""
+        key = (id(program), getattr(program, "_version", 0))
+        hit = self._analysis_cache.get(key)
+        if hit is not None and hit[0] is program:
+            return hit[1], hit[2], hit[3]
+        reads, writes = set(), set()
+        self._block_reads_writes(program, program.global_block(),
+                                 reads, writes, set())
+        persistable = {}
+        for b in program.blocks:
+            for name, v in b.desc.vars.items():
+                if v.persistable:
+                    persistable[name] = True
+        # keep a strong program ref: the cache key uses id(program)
+        self._analysis_cache[key] = (program, reads, writes, persistable)
+        return reads, writes, persistable
+
+    def _external_inputs(self, program, fed: set, scope) -> List[str]:
         """Vars the block reads from the scope: already-present scope vars or
         declared persistables. Reads of undeclared/absent vars are optional
-        inputs (grad cotangents never produced) and resolve to None."""
-        reads, writes = set(), set()
-        self._block_reads_writes(program, block, reads, writes, set(fed))
+        inputs (grad cotangents never produced) and resolve to None.
+        (Computing reads with an empty produced-set and subtracting `fed`
+        is equivalent to seeding produced with `fed`: a fed var read before
+        production lands in reads and is then subtracted.)"""
+        reads, _writes, persistable = self._analysis(program)
         out = []
         for n in sorted(reads - fed):
             if scope.has_var(n) and scope.find_var(n) is not None:
                 out.append(n)
-            else:
-                for b in program.blocks:
-                    if b.desc.has_var(n) and b.desc.var(n).persistable:
-                        out.append(n)
-                        break
+            elif persistable.get(n):
+                out.append(n)
         return out
 
-    def _persistable_outputs(self, program, block) -> List[str]:
-        reads, writes = set(), set()
-        self._block_reads_writes(program, block, reads, writes, set())
-        out = []
-        for n in sorted(writes):
-            for b in program.blocks:
-                if b.desc.has_var(n) and b.desc.var(n).persistable:
-                    out.append(n)
-                    break
-        return out
+    def _persistable_outputs(self, program) -> List[str]:
+        _reads, writes, persistable = self._analysis(program)
+        return [n for n in sorted(writes) if persistable.get(n)]
 
     # --- execution ----------------------------------------------------------
     def _exec_op(self, ctx: LoweringContext, op, env: Dict[str, Any]):
@@ -592,6 +627,11 @@ class Executor:
         prev_env = ctx.env
         ctx.env = env
         ctx.seq_overrides = {}
+        ctx.layout_overrides = {}
+        propagate_tag = None
+        if ctx.layout_opt:
+            from .ops import layout as layout_mod
+            propagate_tag = layout_mod.prepass(ctx.layouts, op, op.type, env)
         ins = {slot: [env.get(n) for n in names]
                for slot, names in op.desc.inputs.items()}
         if op.type not in _SPARSE_AWARE_OPS:
@@ -660,6 +700,11 @@ class Executor:
                         if inherited2 is not None and \
                                 name + SEQLEN2_SUFFIX not in ctx.seq_overrides:
                             env[name + SEQLEN2_SUFFIX] = inherited2
+        if ctx.layout_opt and (ctx.layouts or propagate_tag
+                               or ctx.layout_overrides):
+            from .ops import layout as layout_mod
+            layout_mod.tag_outputs(ctx.layouts, op, env, propagate_tag,
+                                   ctx.layout_overrides)
         ctx.env = prev_env
 
     def _trace_block(self, program, feed_vals, state_vals, fetch_names,
@@ -671,6 +716,12 @@ class Executor:
         block = program.global_block()
         for op in block.ops:
             self._exec_op(ctx, op, env)
+        if ctx.layouts:
+            # fetches and persistable state leave the trace in canonical
+            # NCHW — the internal NHWC convention never escapes a run
+            from .ops import layout as layout_mod
+            layout_mod.canonicalize(ctx.layouts, env,
+                                    list(fetch_names) + list(persist_out))
         from .ops.common import maybe_dense
         fetch = [maybe_dense(env[n]) for n in fetch_names]
         # lengths side channel for fetched sequence vars, so run() can
@@ -811,6 +862,11 @@ class Executor:
                         if not bool(jnp.all(jnp.isfinite(v))):
                             raise FloatingPointError(
                                 f"NaN/Inf in output '{name}' of op {op.type}")
+        if ctx.layouts:
+            from .ops import layout as layout_mod
+            layout_mod.canonicalize(ctx.layouts, env,
+                                    list(fetch_names) + list(persist_out)
+                                    + list(state_vals))
         from .ops.common import maybe_dense
         fetch = [maybe_dense(env[n]) for n in fetch_names]
         fetch_lens = {n: env[n + SEQLEN_SUFFIX] for n in fetch_names

@@ -176,6 +176,9 @@ class Variable:
     @persistable.setter
     def persistable(self, p: bool):
         self.desc.persistable = p
+        # invalidates the executor's cached program analysis (persistable
+        # map) and jit cache — the run signature changes with this flag
+        self.block.program._version += 1
 
     def __str__(self):
         return (f"Variable(name={self.name}, shape={self.shape}, "

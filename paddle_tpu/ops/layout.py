@@ -1,0 +1,245 @@
+"""Internal activation-layout convention for the image path.
+
+The user-visible layout is NCHW (reference conv_op.cc semantics), but the
+TPU MXU wants channels on the minor axis. Instead of transposing around
+every conv (which batch_norm/pool2d running NCHW in between kept XLA from
+cancelling), the executor tracks a per-variable layout *tag* during the
+trace: convs produce NHWC-tagged values, layout-aware ops (batch_norm,
+pool2d) consume and propagate them, layout-agnostic elementwise ops pass
+tags through, and any other consumer forces the value back to canonical
+NCHW first (the "barrier"). Net effect: one NCHW->NHWC transpose where an
+image enters the conv stack and one back where it leaves (usually the
+global-pool -> fc boundary) — the TPU-native equivalent of the reference's
+data_layout_transform pass (framework/data_layout_transform.cc), applied
+at trace time instead of graph-rewrite time.
+
+Gradient consistency falls out of the name-keyed tags: the generic vjp
+grad kernel (ops/registry.py) re-traces the forward lowering against the
+same tag state, cotangents are aligned to the layout of their forward
+value before the vjp, and produced grads inherit the forward var's tag.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+
+# default ON; PADDLE_TPU_NHWC=0 restores per-conv transposes
+LAYOUT_OPT = os.environ.get("PADDLE_TPU_NHWC", "1") == "1"
+
+NHWC = "NHWC"      # 4-D image activations
+NDHWC = "NDHWC"    # 5-D volumetric activations
+
+# ops whose lowerings read/write layout tags themselves
+AWARE_OPS = {
+    "conv2d", "depthwise_conv2d", "conv2d_transpose", "conv3d",
+    "batch_norm", "pool2d",
+}
+
+# elementwise ops that preserve layout: values pass through untouched and
+# the tag propagates to same-rank outputs (their generic vjp grads are
+# consistent because the cotangent is aligned to the forward value)
+AGNOSTIC_OPS = {
+    "relu", "relu6", "leaky_relu", "elu", "sigmoid", "tanh", "abs",
+    "square", "sqrt", "exp", "log", "clip", "scale", "cast", "dropout",
+    "dropout_grad", "pow", "softsign", "softplus", "round", "floor",
+    "ceil", "hard_sigmoid", "brelu", "soft_relu", "swish",
+    # NOT prelu: its channel/element modes reshape alpha assuming NCHW
+    # (vision_ops.py), so it must see canonical layout
+    "sum", "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+}
+
+_TO_CANON = {NHWC: (0, 3, 1, 2), NDHWC: (0, 4, 1, 2, 3)}
+_FROM_CANON = {NHWC: (0, 2, 3, 1), NDHWC: (0, 2, 3, 4, 1)}
+_RANK = {NHWC: 4, NDHWC: 5}
+
+
+def to_canonical(val, tag):
+    """Tagged-layout value -> canonical NCHW/NCDHW."""
+    return jnp.transpose(jnp.asarray(val), _TO_CANON[tag])
+
+
+def from_canonical(val, tag):
+    """Canonical NCHW/NCDHW value -> tagged layout."""
+    return jnp.transpose(jnp.asarray(val), _FROM_CANON[tag])
+
+
+def tag_rank(tag) -> int:
+    return _RANK[tag]
+
+
+def _grad_base(name: str):
+    """'x@GRAD' / 'x@GRAD@RENAME@b0@0' -> 'x'; None for non-grad names."""
+    i = name.find("@GRAD")
+    return name[:i] if i >= 0 else None
+
+
+def _aware_retrace_tag(base, op, layouts):
+    """Layout an aware op's forward lowering emits for its primary output
+    when re-traced against the CURRENT tag state (the vjp re-trace in the
+    generic grad kernel). Convs always emit the TPU layout; pool/bn follow
+    their input's tag. Returns (output_slot, tag)."""
+    if base in ("conv2d", "depthwise_conv2d", "conv2d_transpose"):
+        return "Output", NHWC
+    if base == "conv3d":
+        return "Output", NDHWC
+    if base == "pool2d":
+        t = layouts.get(op.desc.inputs.get("X", [""])[0])
+        return "Out", t if t == NHWC else None
+    if base == "batch_norm":
+        t = layouts.get(op.desc.inputs.get("X", [""])[0])
+        return "Y", t if t in (NHWC, NDHWC) else None
+    return None, None
+
+
+def align_cotangents(layouts, op, env, want_overrides=None):
+    """Before a grad op runs, bring each `<slot>@GRAD` input to the layout
+    the vjp's forward re-trace will produce for that output — by default
+    the forward value's current tag; aware ops pass explicit overrides
+    (their re-trace layout is a function of the op, not the possibly
+    barrier-cleared output tag)."""
+    for slot, names in op.desc.inputs.items():
+        if not slot.endswith("@GRAD"):
+            continue
+        base_slot = slot[: -len("@GRAD")]
+        fwd_names = op.desc.inputs.get(base_slot, [])
+        for gname, fname in zip(names, fwd_names):
+            if want_overrides and base_slot in want_overrides:
+                want = want_overrides[base_slot]
+            else:
+                want = layouts.get(fname)
+            have = layouts.get(gname)
+            if want == have:
+                continue
+            val = env.get(gname)
+            if val is None or getattr(val, "ndim", 0) != _RANK[want or have]:
+                continue
+            if have is not None:
+                val = to_canonical(val, have)
+                layouts.pop(gname, None)
+            if want is not None:
+                val = from_canonical(val, want)
+                layouts[gname] = want
+            env[gname] = val
+
+
+def _elementwise_tag_ok(op, env, tag):
+    """Layout-tag pass-through is safe for an elementwise op iff broadcast
+    semantics are unaffected: equal shapes, scalar Y, or the channel-bias
+    form (axis==1, 1-D Y) which the lowering remaps to the minor axis."""
+    if op.type == "sum" or not op.type.startswith("elementwise_"):
+        return True
+    ynames = op.desc.inputs.get("Y", [])
+    y = env.get(ynames[0]) if ynames else None
+    if y is None:
+        return True
+    xnames = op.desc.inputs.get("X", [])
+    x = env.get(xnames[0]) if xnames else None
+    if x is None:
+        return False
+    if getattr(y, "ndim", 0) == 0 or getattr(y, "shape", None) == x.shape:
+        return True
+    axis = op.attr("axis", -1)
+    return axis == 1 and getattr(y, "ndim", 0) == 1
+
+
+def prepass(layouts, op, op_type, env):
+    """Called by the executor before lowering `op`. Enforces the invariant
+    that every env value's layout matches its tag: unaware consumers get
+    tagged inputs canonicalized in place (the barrier); agnostic consumers
+    pass through when all same-rank inputs share one tag. Returns the tag
+    to propagate to the op's outputs (None = no propagation)."""
+    base = op_type[: -len("_grad")] if op_type.endswith("_grad") \
+        else op_type
+    if base in AWARE_OPS:
+        # runs even with no live tags: conv lowerings emit the TPU layout
+        # unconditionally, so their cotangents always need aligning
+        if op_type.endswith("_grad"):
+            out_slot, tag = _aware_retrace_tag(base, op, layouts)
+            align_cotangents(layouts, op, env,
+                             want_overrides={out_slot: tag}
+                             if out_slot else None)
+        return None    # aware lowerings manage tags themselves
+    if not layouts:
+        return None
+    in_names = [n for names in op.desc.inputs.values() for n in names]
+    tags = {layouts[n] for n in in_names if n in layouts}
+    if not tags:
+        return None
+    if base in AGNOSTIC_OPS and len(tags) == 1:
+        tag = next(iter(tags))
+        rank = _RANK[tag]
+        # every input of the tag's rank must carry the tag — an untagged
+        # same-rank operand would be in a different layout
+        uniform = all(
+            layouts.get(n) == tag
+            for n in in_names
+            if getattr(env.get(n), "ndim", None) == rank)
+        if uniform and _elementwise_tag_ok(op, env, tag):
+            if op_type.endswith("_grad"):
+                align_cotangents(layouts, op, env)
+            return tag
+    # barrier: canonicalize tagged inputs in place
+    for n in in_names:
+        tag = layouts.pop(n, None)
+        if tag is not None and env.get(n) is not None:
+            env[n] = to_canonical(env[n], tag)
+    if op_type.endswith("_grad"):
+        align_cotangents(layouts, op, env)
+    return None
+
+
+def tag_outputs(layouts, op, env, propagate_tag, overrides):
+    """After an op runs: aware-lowering overrides (ctx.set_layout) win;
+    agnostic outputs inherit the propagated tag; a true grad op's
+    `<base>@GRAD*` outputs inherit the forward var's current tag (the
+    aligned vjp produced them in that layout — this does NOT hold for
+    plain forward ops a custom grad maker re-emits in the backward pass,
+    e.g. cast-grad-as-cast, which follow normal propagation); everything
+    else clears any stale tag (names can be rewritten)."""
+    is_grad_op = op.type.endswith("_grad")
+    in_names = {n for ns in op.desc.inputs.values() for n in ns} \
+        if is_grad_op else ()
+    for names in op.desc.outputs.values():
+        for name in names:
+            val = env.get(name)
+            if val is None:
+                continue
+            if name in overrides:
+                tag = overrides[name]
+                if tag is None:
+                    layouts.pop(name, None)
+                else:
+                    layouts[name] = tag
+                continue
+            # a vjp-produced grad matches the layout of the forward value
+            # the vjp consumed — which requires that forward var to BE an
+            # input of this grad op (custom grad lowerings that never see
+            # the forward var, e.g. dropout_grad, compute in their own
+            # inputs' layout and follow normal propagation instead)
+            gb = _grad_base(name) if is_grad_op else None
+            if gb is not None and gb not in in_names:
+                gb = None
+            if gb is not None:
+                gt = layouts.get(gb)
+                if gt is not None and getattr(val, "ndim", 0) == _RANK[gt]:
+                    layouts[name] = gt
+                else:
+                    layouts.pop(name, None)
+            elif propagate_tag is not None and \
+                    getattr(val, "ndim", 0) == _RANK[propagate_tag]:
+                layouts[name] = propagate_tag
+            else:
+                layouts.pop(name, None)
+
+
+def canonicalize(layouts, env, names):
+    """Force the given env entries back to canonical layout (fetch /
+    persistable-state boundary)."""
+    for n in names:
+        tag = layouts.get(n)
+        if tag is not None and env.get(n) is not None:
+            env[n] = to_canonical(env[n], tag)
+            layouts.pop(n, None)

@@ -129,7 +129,14 @@ def _ew_infer(op_, block):
 def _make_ew(fn):
     def lower(ctx, op_, ins):
         x = jnp.asarray(ins["X"][0])
-        y = broadcast_y_to_x(x, ins["Y"][0], op_.attr("axis", -1))
+        axis = op_.attr("axis", -1)
+        # channel-bias form (axis==1, 1-D Y) under the internal NHWC
+        # convention (ops/layout.py): the channel axis is minor, so the
+        # broadcast target moves to the last dim
+        if axis == 1 and getattr(ins["Y"][0], "ndim", 0) == 1 and \
+                ctx.layout_of(op_.desc.inputs["X"][0]) is not None:
+            axis = x.ndim - 1
+        y = broadcast_y_to_x(x, ins["Y"][0], axis)
         # AMP O2: an f32 operand (e.g. a master-weight bias) must not
         # promote a bf16 activation back to f32 — that would silently
         # re-materialize f32 tensors at every fc/conv bias add and forfeit

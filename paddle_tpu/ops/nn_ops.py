@@ -252,25 +252,34 @@ def _conv2d_infer(op_, block):
 
 @op("conv2d", infer_shape=_conv2d_infer)
 def _conv2d(ctx, op_, ins):
+    """Computes in NHWC — the TPU-preferred conv layout (channels on the
+    minor axis feed the MXU directly; measured ~2x over NCHW on v5e).
+    Under the trace-time layout convention (ops/layout.py) the NHWC
+    result is kept and tagged so the whole conv/bn/pool stack runs NHWC
+    with one transpose at each end; with the convention off, the
+    user-visible NCHW layout is restored per conv."""
+    from . import layout as layout_mod
     x = jnp.asarray(ins["Input"][0])
     w = jnp.asarray(ins["Filter"][0])
     s = _pair(op_.attr("strides", [1, 1]))
     p = _pair(op_.attr("paddings", [0, 0]))
     d = _pair(op_.attr("dilations", [1, 1]))
     groups = op_.attr("groups", 1) or 1
+    nhwc_in = ctx.layout_of(op_.desc.inputs["Input"][0]) == layout_mod.NHWC
     (x, w), restore = mxu_cast(ctx, x, w)
-    # Compute in NHWC: the TPU-preferred conv layout (channels on the minor
-    # axis feed the MXU directly; measured ~2x over NCHW on v5e). The
-    # user-visible layout stays NCHW — XLA cancels the transposes between
-    # chained convs and fuses the rest into neighbouring elementwise ops.
+    if not nhwc_in:
+        x = jnp.transpose(x, (0, 2, 3, 1))
     out = jax.lax.conv_general_dilated(
-        jnp.transpose(x, (0, 2, 3, 1)), jnp.transpose(w, (2, 3, 1, 0)),
+        x, jnp.transpose(w, (2, 3, 1, 0)),
         window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
         rhs_dilation=d, feature_group_count=groups,
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    out = jnp.transpose(out, (0, 3, 1, 2))
     if restore is not None:
         out = out.astype(restore)
+    if ctx.layout_opt:
+        ctx.set_layout(op_.desc.outputs["Output"][0], layout_mod.NHWC)
+    else:
+        out = jnp.transpose(out, (0, 3, 1, 2))
     return {"Output": [out]}
 
 
@@ -295,19 +304,29 @@ def _conv3d_infer(op_, block):
 
 @op("conv3d", infer_shape=_conv3d_infer)
 def _conv3d(ctx, op_, ins):
+    """NDHWC compute for the MXU, same layout convention as conv2d."""
+    from . import layout as layout_mod
     x = jnp.asarray(ins["Input"][0])
     w = jnp.asarray(ins["Filter"][0])
     s = _pair(op_.attr("strides", [1, 1, 1]), 3)
     p = _pair(op_.attr("paddings", [0, 0, 0]), 3)
     d = _pair(op_.attr("dilations", [1, 1, 1]), 3)
     groups = op_.attr("groups", 1) or 1
+    ndhwc_in = ctx.layout_of(op_.desc.inputs["Input"][0]) == layout_mod.NDHWC
     (x, w), restore = mxu_cast(ctx, x, w)
+    if not ndhwc_in:
+        x = jnp.transpose(x, (0, 2, 3, 4, 1))
     out = jax.lax.conv_general_dilated(
-        x, w, window_strides=s, padding=[(pi, pi) for pi in p],
+        x, jnp.transpose(w, (2, 3, 4, 1, 0)),
+        window_strides=s, padding=[(pi, pi) for pi in p],
         rhs_dilation=d, feature_group_count=groups,
-        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
     if restore is not None:
         out = out.astype(restore)
+    if ctx.layout_opt:
+        ctx.set_layout(op_.desc.outputs["Output"][0], layout_mod.NDHWC)
+    else:
+        out = jnp.transpose(out, (0, 4, 1, 2, 3))
     return {"Output": [out]}
 
 
@@ -332,6 +351,9 @@ def _convt2d_infer(op_, block):
 
 @op("conv2d_transpose", infer_shape=_convt2d_infer)
 def _conv2d_transpose(ctx, op_, ins):
+    """Gradient-of-conv formulation (dilate the input by stride, pad by
+    k-1-p), computed in NHWC for the MXU like conv2d."""
+    from . import layout as layout_mod
     x = jnp.asarray(ins["Input"][0])
     w = jnp.asarray(ins["Filter"][0])   # (Cin, Cout, kh, kw) = IOHW
     s = _pair(op_.attr("strides", [1, 1]))
@@ -339,16 +361,23 @@ def _conv2d_transpose(ctx, op_, ins):
     d = _pair(op_.attr("dilations", [1, 1]))
     kh = d[0] * (w.shape[2] - 1) + 1
     kw = d[1] * (w.shape[3] - 1) + 1
-    # Gradient-of-conv formulation: dilate the input by stride, pad by k-1-p.
+    nhwc_in = ctx.layout_of(op_.desc.inputs["Input"][0]) == layout_mod.NHWC
     (x, w), restore = mxu_cast(ctx, x, w)
+    if not nhwc_in:
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    # (Cin, Cout, kh, kw) flipped spatially -> HWIO with I=Cin, O=Cout
     out = jax.lax.conv_general_dilated(
-        x, jnp.flip(w, (2, 3)).swapaxes(0, 1),  # -> OIHW flipped
+        x, jnp.transpose(jnp.flip(w, (2, 3)), (2, 3, 0, 1)),
         window_strides=(1, 1),
         padding=[(kh - 1 - p[0], kh - 1 - p[0]), (kw - 1 - p[1], kw - 1 - p[1])],
         lhs_dilation=s, rhs_dilation=d,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
     if restore is not None:
         out = out.astype(restore)
+    if ctx.layout_opt:
+        ctx.set_layout(op_.desc.outputs["Output"][0], layout_mod.NHWC)
+    else:
+        out = jnp.transpose(out, (0, 3, 1, 2))
     return {"Output": [out]}
 
 
@@ -376,30 +405,41 @@ def _pool2d_infer(op_, block):
 
 @op("pool2d", infer_shape=_pool2d_infer)
 def _pool2d(ctx, op_, ins):
+    from . import layout as layout_mod
     x = jnp.asarray(ins["X"][0])
+    nhwc = ctx.layout_of(op_.desc.inputs["X"][0]) == layout_mod.NHWC
+    sp = (1, 2) if nhwc else (2, 3)   # spatial dims in the live layout
     ptype = op_.attr("pooling_type", "max")
     if op_.attr("global_pooling", False):
-        k = list(x.shape[2:])
+        k = [x.shape[sp[0]], x.shape[sp[1]]]
         s, p = k, [0, 0]
     else:
         k = _pair(op_.attr("ksize"))
         s = _pair(op_.attr("strides", [1, 1]))
         p = _pair(op_.attr("paddings", [0, 0]))
-    window = (1, 1, k[0], k[1])
-    strides = (1, 1, s[0], s[1])
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if nhwc:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    else:
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
     if ptype == "max":
         init = -jnp.inf
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides, pads)
     else:
         out = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
         if op_.attr("exclusive", True):
-            ones = jnp.ones(x.shape[2:], dtype=x.dtype)[None, None]
+            ones = jnp.ones((x.shape[sp[0]], x.shape[sp[1]]), dtype=x.dtype)
+            ones = ones[None, :, :, None] if nhwc else ones[None, None]
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
                                         strides, pads)
             out = out / cnt
         else:
             out = out / (k[0] * k[1])
+    if nhwc:
+        ctx.set_layout(op_.desc.outputs["Out"][0], layout_mod.NHWC)
     return {"Out": [out]}
 
 
@@ -418,6 +458,7 @@ def _bn_infer(op_, block):
 @op("batch_norm", infer_shape=_bn_infer,
     non_diff_inputs=("Mean", "Variance"))
 def _batch_norm(ctx, op_, ins):
+    from . import layout as layout_mod
     x = jnp.asarray(ins["X"][0])
     scale = jnp.asarray(ins["Scale"][0])
     bias = jnp.asarray(ins["Bias"][0])
@@ -426,9 +467,12 @@ def _batch_norm(ctx, op_, ins):
     eps = op_.attr("epsilon", 1e-5)
     momentum = op_.attr("momentum", 0.9)
     is_test = op_.attr("is_test", False)
-    axes = tuple(i for i in range(x.ndim) if i != 1)
+    tag = ctx.layout_of(op_.desc.inputs["X"][0])
+    # channel axis: minor under the internal NHWC/NDHWC convention
+    ch = (x.ndim - 1) if tag in (layout_mod.NHWC, layout_mod.NDHWC) else 1
+    axes = tuple(i for i in range(x.ndim) if i != ch)
     shape = [1] * x.ndim
-    shape[1] = x.shape[1]
+    shape[ch] = x.shape[ch]
 
     # statistics always in f32 — bf16 inputs (AMP O2) would lose too many
     # mantissa bits in the mean/var reductions; output returns to x's dtype
@@ -465,6 +509,8 @@ def _batch_norm(ctx, op_, ins):
     y = (xf - use_mean.reshape(shape)) * (inv * scale).reshape(shape) \
         + bias.reshape(shape)
     y = y.astype(x.dtype)
+    if tag in (layout_mod.NHWC, layout_mod.NDHWC):
+        ctx.set_layout(op_.desc.outputs["Y"][0], tag)
     return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
             "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
 
