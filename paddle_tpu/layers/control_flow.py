@@ -109,11 +109,16 @@ class While:
     cond must be a bool Variable; every loop-state var (anything assigned in
     the body that must survive iterations, including cond) must hold a value
     before the loop starts.
+
+    max_iters bounds the step-scope recording used by while_grad (default
+    128); a training loop that exceeds it gets NaN-poisoned gradients, so
+    raise it to the true iteration bound for long loops.
     """
 
-    def __init__(self, cond, name=None):
+    def __init__(self, cond, name=None, max_iters=None):
         self.helper = LayerHelper("while", name=name)
         self.cond_var = cond
+        self.max_iters = max_iters
 
     @contextlib.contextmanager
     def block(self):
@@ -136,11 +141,14 @@ class While:
                     writes.append(n)
         x_names = [n for n in reads
                    if parent_block.has_var_recursive(n)]
+        attrs = {"sub_block": BlockRef(sub_block.idx)}
+        if self.max_iters is not None:
+            attrs["max_loop_iters"] = int(self.max_iters)
         parent_block.append_op(
             type="while",
             inputs={"Condition": [self.cond_var.name], "X": x_names},
             outputs={"Out": writes},
-            attrs={"sub_block": BlockRef(sub_block.idx)})
+            attrs=attrs)
 
 
 # --- ConditionalBlock / IfElse / Switch -------------------------------------
@@ -162,14 +170,25 @@ class ConditionalBlock:
         sub_block = program.create_block()
         yield
         program.rollback()
-        out_names = []
+        out_names, produced, reads = [], set(), []
         for op_ in sub_block.ops:
+            for n in op_.input_arg_names:
+                if n not in produced and n not in reads:
+                    reads.append(n)
             for n in op_.output_arg_names:
+                produced.add(n)
                 if n not in out_names:
                     out_names.append(n)
+        # explicit reads (weights, pre-existing outputs) so grads flow to
+        # them through conditional_block_grad instead of being closure
+        # constants under vjp
+        x_names = [n for n in reads if parent_block.has_var_recursive(n)]
+        for n in out_names:
+            if parent_block.has_var_recursive(n) and n not in x_names:
+                x_names.append(n)
         parent_block.append_op(
             type="conditional_block",
-            inputs={"Cond": [v.name for v in self.inputs]},
+            inputs={"Cond": [v.name for v in self.inputs], "X": x_names},
             outputs={"Out": out_names},
             attrs={"sub_block": BlockRef(sub_block.idx),
                    "is_scalar_condition": self.is_scalar_condition})

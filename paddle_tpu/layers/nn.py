@@ -980,7 +980,9 @@ def prelu(x, mode="all", param_attr=None, name=None):
     elif mode == "channel":
         alpha_shape = [x.shape[1]]
     else:
-        alpha_shape = list(x.shape)
+        # element mode: one alpha per feature element, broadcast over the
+        # batch dim (which is -1 for data vars and must not size a param)
+        alpha_shape = [1] + list(x.shape[1:])
     from ..initializer import Constant
     alpha = helper.create_parameter(attr=helper.param_attr,
                                     shape=alpha_shape, dtype=x.dtype,

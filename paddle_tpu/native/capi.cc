@@ -7,6 +7,7 @@
 #include <Python.h>
 
 #include <cstring>
+#include <limits>
 #include <mutex>
 #include <string>
 #include <vector>
@@ -97,8 +98,18 @@ paddle_error paddle_tpu_machine_set_input(paddle_tpu_machine machine,
       dims == nullptr)
     return PD_NULLPTR;
   Machine* m = static_cast<Machine*>(machine);
+  if (ndim < 0) return PD_OUT_OF_RANGE;
   int64_t numel = 1;
-  for (int i = 0; i < ndim; ++i) numel *= dims[i];
+  for (int i = 0; i < ndim; ++i) {
+    if (dims[i] < 0) return PD_OUT_OF_RANGE;
+    if (dims[i] != 0 &&
+        numel > std::numeric_limits<int64_t>::max() / dims[i])
+      return PD_OUT_OF_RANGE;  // numel overflow
+    numel *= dims[i];
+  }
+  if (numel > std::numeric_limits<int64_t>::max() /
+                  static_cast<int64_t>(sizeof(float)))
+    return PD_OUT_OF_RANGE;  // byte-size overflow
   Gil gil;
   PyObject* dims_tuple = PyTuple_New(ndim);
   for (int i = 0; i < ndim; ++i)
@@ -130,13 +141,29 @@ paddle_error paddle_tpu_machine_forward(paddle_tpu_machine machine) {
   m->out_data.clear();
   m->out_dims.clear();
   Py_ssize_t n = PyList_Size(outs);
+  if (n < 0) {  // forward() did not return a list
+    PyErr_Clear();
+    Py_DECREF(outs);
+    return PD_UNDEFINED_ERROR;
+  }
   for (Py_ssize_t i = 0; i < n; ++i) {
     PyObject* pair = PyList_GetItem(outs, i);            // borrowed
+    if (pair == nullptr || !PyTuple_Check(pair) || PyTuple_Size(pair) < 2) {
+      PyErr_Clear();
+      Py_DECREF(outs);
+      return PD_UNDEFINED_ERROR;
+    }
     PyObject* payload = PyTuple_GetItem(pair, 0);        // borrowed
     PyObject* dims = PyTuple_GetItem(pair, 1);           // borrowed
     char* buf;
     Py_ssize_t len;
-    PyBytes_AsStringAndSize(payload, &buf, &len);
+    if (payload == nullptr || dims == nullptr ||
+        PyBytes_AsStringAndSize(payload, &buf, &len) != 0 ||
+        len % static_cast<Py_ssize_t>(sizeof(float)) != 0) {
+      PyErr_Clear();
+      Py_DECREF(outs);
+      return PD_UNDEFINED_ERROR;
+    }
     std::vector<float> vals(len / sizeof(float));
     std::memcpy(vals.data(), buf, len);
     std::vector<int64_t> shape;

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..framework.desc import BlockRef
+from ..framework.desc import BlockRef, OpDesc
 from .common import in_var, set_out
 from .registry import NO_GRAD, op
 
@@ -90,6 +90,60 @@ def _lod_array_length(ctx, op_, ins):
     return {"Out": [arr.length.reshape(1).astype(jnp.int64)]}
 
 
+class StepScopesVal:
+    """Recorded loop state for while_grad (reference while_op.cc keeps the
+    per-iteration step scopes alive in its StepScopes output for the grad op
+    to replay; here the record is a stacked pytree of pre-iteration carries
+    plus the executed iteration count)."""
+
+    def __init__(self, names, records, count):
+        self.names = tuple(names)        # carry var names (static)
+        self.records = records           # name -> pytree stacked [C, ...]
+        self.count = count               # int32 iterations executed
+
+    def __repr__(self):
+        return f"StepScopesVal(names={self.names})"
+
+
+def _ss_flatten(ss):
+    return ((tuple(ss.records[n] for n in ss.names), ss.count), ss.names)
+
+
+def _ss_unflatten(names, children):
+    recs, count = children
+    return StepScopesVal(names, dict(zip(names, recs)), count)
+
+
+jax.tree_util.register_pytree_node(StepScopesVal, _ss_flatten, _ss_unflatten)
+
+
+class ScopeRecordVal:
+    """Pre-op values of outer vars a conditional_block overwrites (the
+    conditional analogue of StepScopesVal: conditional_block_grad needs the
+    else-branch passthrough values, which the forward op has clobbered in
+    the env by the time the grad op runs)."""
+
+    def __init__(self, names, values):
+        self.names = tuple(names)
+        self.values = values             # name -> pytree
+
+    def __repr__(self):
+        return f"ScopeRecordVal(names={self.names})"
+
+
+def _sr_flatten(sr):
+    return (tuple(sr.values[n] for n in sr.names), sr.names)
+
+
+def _sr_unflatten(names, children):
+    return ScopeRecordVal(names, dict(zip(names, children)))
+
+
+jax.tree_util.register_pytree_node(ScopeRecordVal, _sr_flatten, _sr_unflatten)
+
+DEFAULT_MAX_LOOP_ITERS = 128
+
+
 def _block_writes(program, block_idx) -> List[str]:
     """All var names written by a block (recursively through sub-blocks)."""
     writes: List[str] = []
@@ -109,13 +163,19 @@ def _block_writes(program, block_idx) -> List[str]:
     return writes
 
 
-@op("while", grad=NO_GRAD, no_kernel=True)
+@op("while", grad=NO_GRAD, no_kernel=True)  # real maker assigned below
 def _while(ctx, op_, ins):
     """while(Condition) { sub_block } (reference while_op.cc:35).
 
     Carries = every var the sub-block writes that already has a value in the
     outer env (loop state must be initialized before the loop), plus the
     condition var. Everything else the sub-block reads is closed over.
+
+    When append_backward marks the op with `record_step_scopes`, the loop
+    additionally records the pre-iteration carry of every step into
+    fixed-capacity stacked buffers (attr `max_loop_iters`, default 128) —
+    the functional analogue of the reference keeping step scopes alive for
+    WhileGradOp (while_op.cc:96). while_grad replays them reversed.
     """
     program = ctx.program
     sub = op_.attr("sub_block")
@@ -129,27 +189,238 @@ def _while(ctx, op_, ins):
     outer_env = ctx.env
     base_env = dict(outer_env)
 
-    def cond_fn(carry):
-        return jnp.asarray(carry[cond_name]).reshape(()).astype(bool)
+    record = bool(op_.attr("record_step_scopes", False)) and \
+        bool(op_.desc.outputs.get("StepScopes"))
+    cap = int(op_.attr("max_loop_iters", 0) or DEFAULT_MAX_LOOP_ITERS)
 
-    def body_fn(carry):
+    def body_env(carry):
         env2 = dict(base_env)
         env2.update(carry)
         ctx.run_block(sub.idx, env2)
         return {n: env2[n] for n in carry_names}
 
     init = {n: outer_env[n] for n in carry_names}
-    final = lax.while_loop(cond_fn, body_fn, init)
+
+    if not record:
+        def cond_fn(carry):
+            return jnp.asarray(carry[cond_name]).reshape(()).astype(bool)
+
+        final = lax.while_loop(cond_fn, body_env, init)
+        out_names = op_.desc.outputs.get("Out", [])
+        return {"Out": [final.get(n) for n in out_names]}
+
+    rec0 = {n: jax.tree.map(
+        lambda x: jnp.zeros((cap,) + jnp.asarray(x).shape,
+                            jnp.asarray(x).dtype), init[n])
+        for n in carry_names}
+
+    def cond_fn(state):
+        carry, i, _rec = state
+        return jnp.asarray(carry[cond_name]).reshape(()).astype(bool)
+
+    def body_fn(state):
+        carry, i, rec = state
+        j = jnp.minimum(i, cap - 1)
+        rec = {n: jax.tree.map(
+            lambda b, x: lax.dynamic_update_index_in_dim(
+                b, jnp.asarray(x), j, axis=0), rec[n], carry[n])
+            for n in carry_names}
+        return body_env(carry), i + 1, rec
+
+    final, count, rec = lax.while_loop(
+        cond_fn, body_fn, (init, jnp.asarray(0, jnp.int32), rec0))
+    ss = StepScopesVal(carry_names, rec, count)
     out_names = op_.desc.outputs.get("Out", [])
-    return {"Out": [final.get(n) for n in out_names]}
+    return {"Out": [final.get(n) for n in out_names], "StepScopes": [ss]}
 
 
-@op("conditional_block", grad=NO_GRAD, no_kernel=True)
+def _zeros_ct(primal):
+    """Zero cotangent for a primal pytree: float leaves get jnp zeros,
+    integer/bool leaves get int-dtype placeholders (swapped for float0 at
+    the vjp boundary by _to_vjp_ct)."""
+    return jax.tree.map(lambda x: jnp.zeros_like(jnp.asarray(x)), primal)
+
+
+def _to_vjp_ct(ct, primal):
+    """Convert carried cotangents to what jax.vjp accepts: float0 for
+    non-inexact primal leaves."""
+    def conv(c, p):
+        p = jnp.asarray(p)
+        if jnp.issubdtype(p.dtype, jnp.inexact):
+            return jnp.asarray(c, p.dtype)
+        return np.zeros(p.shape, dtype=jax.dtypes.float0)
+    return jax.tree.map(conv, ct, primal)
+
+
+def _from_vjp_ct(ct, primal):
+    """Inverse of _to_vjp_ct: float0 leaves back to int placeholders so the
+    structure can ride a lax.scan carry."""
+    def conv(c, p):
+        p = jnp.asarray(p)
+        if jnp.issubdtype(p.dtype, jnp.inexact):
+            return c
+        return jnp.zeros_like(p)
+    return jax.tree.map(conv, ct, primal)
+
+
+def _while_grad_maker(fwd, no_grad_set):
+    """Emit while_grad + mark the forward op to record step scopes
+    (reference while_op.cc:96 WhileGradOp / while grad maker)."""
+    from ..framework.framework import grad_var_name
+    out_names = list(fwd.outputs.get("Out", []))
+    x_names = list(fwd.inputs.get("X", []))
+    gx = [n for n in x_names if n not in no_grad_set]
+    if not gx:
+        return []
+    ss_name = (out_names[0] if out_names else x_names[0]) + "@STEP_SCOPES"
+    fwd.outputs["StepScopes"] = [ss_name]
+    fwd.attrs["record_step_scopes"] = True
+    g = OpDesc(
+        type="while_grad",
+        inputs={"Condition": list(fwd.inputs["Condition"]),
+                "X": x_names,
+                "Out": out_names,
+                "Out@GRAD": [grad_var_name(n) for n in out_names],
+                "StepScopes": [ss_name]},
+        outputs={"X@GRAD": [grad_var_name(n) for n in gx]},
+        attrs=dict(fwd.attrs))
+    return [g]
+
+
+from . import registry as _registry_mod  # noqa: E402
+_registry_mod.get("while").grad = _while_grad_maker
+
+
+@op("while_grad", grad=NO_GRAD, no_kernel=True)
+def _while_grad(ctx, op_, ins):
+    """Reverse sweep of a recorded while loop: for j = n-1 .. 0, vjp of the
+    loop body at the recorded carry, masked past the executed count
+    (reference while_op.cc:96; the bounded-scan replay is the XLA-legal
+    form of running the grad block once per retained step scope)."""
+    program = ctx.program
+    sub = op_.attr("sub_block")
+    ss = ins["StepScopes"][0]
+    assert isinstance(ss, StepScopesVal), "while_grad needs recorded scopes"
+    carry_names = list(ss.names)
+    rec, count = ss.records, ss.count
+    cap = int(op_.attr("max_loop_iters", 0) or DEFAULT_MAX_LOOP_ITERS)
+
+    x_names = op_.desc.inputs.get("X", [])
+    x_vals = dict(zip(x_names, ins.get("X", [])))
+    out_names = op_.desc.inputs.get("Out", [])
+    out_cts = dict(zip(out_names, ins.get("Out@GRAD", [])))
+
+    base_env = dict(ctx.env)
+    base_env.update({n: v for n, v in x_vals.items() if v is not None})
+
+    def _leafs_inexact(v):
+        return all(jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+                   for l in jax.tree.leaves(v))
+
+    # differentiable non-carried reads (weights etc.); carried names get
+    # their grads from the reverse-carry cotangent instead
+    xd_names = [n for n in x_names
+                if n not in carry_names and x_vals.get(n) is not None
+                and _leafs_inexact(x_vals[n])]
+    x_diff = {n: x_vals[n] for n in xd_names}
+
+    def body_pure(carry, xd):
+        env2 = dict(base_env)
+        env2.update(xd)
+        env2.update(carry)
+        ctx.run_block(sub.idx, env2)
+        return {n: env2[n] for n in carry_names}
+
+    # initial cotangent of the loop state = grads of the while outputs
+    g0 = {}
+    carry_tmpl = {n: jax.tree.map(lambda r: r[0], rec[n])
+                  for n in carry_names}
+    for n in carry_names:
+        ct = out_cts.get(n)
+        tmpl = carry_tmpl[n]
+        if ct is not None and jax.tree.structure(ct) == \
+                jax.tree.structure(tmpl):
+            g0[n] = jax.tree.map(
+                lambda c, p: jnp.asarray(c, jnp.asarray(p).dtype), ct, tmpl)
+        else:
+            g0[n] = _zeros_ct(tmpl)
+    xbar0 = _zeros_ct(x_diff)
+
+    def rev_step(state, j):
+        g, xbar = state
+        active = j < count
+        carry_j = {n: jax.tree.map(lambda r: r[j], rec[n])
+                   for n in carry_names}
+        out_primal, vjp_fn = jax.vjp(body_pure, carry_j, x_diff)
+        ct = _to_vjp_ct(g, out_primal)
+        dc, dx = vjp_fn(ct)
+        dc = _from_vjp_ct(dc, carry_j)
+        dx = _from_vjp_ct(dx, x_diff)
+        g_new = jax.tree.map(lambda a, b: jnp.where(active, a, b), dc, g)
+        xbar_new = jax.tree.map(
+            lambda xb, d: xb + jnp.where(active, d, jnp.zeros_like(d)),
+            xbar, dx)
+        return (g_new, xbar_new), None
+
+    js = jnp.arange(cap - 1, -1, -1)
+    (g_fin, xbar_fin), _ = lax.scan(rev_step, (g0, xbar0), js)
+
+    gx_names = op_.desc.outputs.get("X@GRAD", [])
+    grads = []
+    for gn in gx_names:
+        base = gn.split("@RENAME@")[0]
+        if base.endswith("@GRAD"):
+            base = base[: -len("@GRAD")]
+        if base in carry_names:
+            v = g_fin[base]
+            grads.append(v if _leafs_inexact(carry_tmpl[base]) else None)
+        elif base in x_diff:
+            grads.append(xbar_fin[base])
+        else:
+            grads.append(None)
+
+    # If the loop ran past the recording capacity, the replay is truncated
+    # and every gradient is undefined — poison with NaN so training fails
+    # loudly instead of converging to a silently wrong optimum. Raise the
+    # cap via While(cond, max_iters=N).
+    overflow = count > cap
+
+    def _poison(v):
+        v = jnp.asarray(v)
+        if jnp.issubdtype(v.dtype, jnp.inexact):
+            return jnp.where(overflow, jnp.full_like(v, jnp.nan), v)
+        return v
+
+    grads = [jax.tree.map(_poison, g) if g is not None else None
+             for g in grads]
+    return {"X@GRAD": grads}
+
+
+def _cond_apply(ctx, sub_idx, base_env, out_names, pred, carry, xd):
+    """Pure form of conditional_block shared by forward + grad: lax.cond over
+    {run sub-block, passthrough}, with explicit reads `xd` so vjp sees them
+    as primals."""
+
+    def then_fn(carry, xd):
+        env2 = dict(base_env)
+        env2.update(xd)
+        env2.update(carry)
+        ctx.run_block(sub_idx, env2)
+        return [env2[n] for n in out_names]
+
+    def else_fn(carry, xd):
+        return [carry[n] for n in out_names]
+
+    return lax.cond(pred, then_fn, else_fn, carry, xd)
+
+
+@op("conditional_block", grad=NO_GRAD, no_kernel=True)  # maker set below
 def _conditional_block(ctx, op_, ins):
     """if(cond) { sub_block } (reference conditional_block_op.cc). Vars the
     sub-block writes must either pre-exist in the outer env (else-branch
     keeps them) or they default to zeros shaped like the then-branch
-    result."""
+    result. With `record_scope` set (by the grad maker), the pre-op carry
+    is emitted through the Scope output for conditional_block_grad."""
     program = ctx.program
     sub = op_.attr("sub_block")
     cond = ins["Cond"][0]
@@ -161,27 +432,124 @@ def _conditional_block(ctx, op_, ins):
     outer_env = ctx.env
     base_env = dict(outer_env)
 
-    def then_fn(carry):
-        env2 = dict(base_env)
-        env2.update(carry)
-        ctx.run_block(sub.idx, env2)
-        return [env2[n] for n in out_names]
-
     # seed carry with pre-existing values; for fresh vars, use zeros shaped
     # like the then-branch output (jax.eval_shape avoids running it)
     carry = {n: outer_env[n] for n in out_names if n in outer_env}
     missing = [n for n in out_names if n not in carry]
     if missing:
-        shapes = jax.eval_shape(then_fn, carry)
+        def then_probe(c):
+            env2 = dict(base_env)
+            env2.update(c)
+            ctx.run_block(sub.idx, env2)
+            return [env2[n] for n in out_names]
+        shapes = jax.eval_shape(then_probe, carry)
         for n, sd in zip(out_names, shapes):
             if n in missing:
                 carry[n] = jnp.zeros(sd.shape, sd.dtype)
 
-    def else_fn(c):
-        return [c[n] for n in out_names]
+    outs = _cond_apply(ctx, sub.idx, base_env, out_names, pred, carry, {})
+    result = {"Out": list(outs)}
+    if bool(op_.attr("record_scope", False)) and \
+            op_.desc.outputs.get("Scope"):
+        result["Scope"] = [ScopeRecordVal(out_names,
+                                          {n: carry[n] for n in out_names})]
+    return result
 
-    outs = lax.cond(pred, then_fn, else_fn, carry)
-    return {"Out": list(outs)}
+
+def _conditional_block_grad_maker(fwd, no_grad_set):
+    """Emit conditional_block_grad (reference conditional_block_op.cc
+    ConditionalBlockGradOp) + mark the forward op to record its pre-op
+    carry."""
+    from ..framework.framework import grad_var_name
+    out_names = list(fwd.outputs.get("Out", []))
+    x_names = list(fwd.inputs.get("X", []))
+    if not out_names:
+        return []
+    gx = [n for n in x_names if n not in no_grad_set]
+    if not gx:
+        return []
+    scope_name = out_names[0] + "@COND_SCOPE"
+    fwd.outputs["Scope"] = [scope_name]
+    fwd.attrs["record_scope"] = True
+    g = OpDesc(
+        type="conditional_block_grad",
+        inputs={"Cond": list(fwd.inputs["Cond"]),
+                "X": x_names,
+                "Out": out_names,
+                "Out@GRAD": [grad_var_name(n) for n in out_names],
+                "Scope": [scope_name]},
+        outputs={"X@GRAD": [grad_var_name(n) for n in gx]},
+        attrs=dict(fwd.attrs))
+    return [g]
+
+
+@op("conditional_block_grad", grad=NO_GRAD, no_kernel=True)
+def _conditional_block_grad(ctx, op_, ins):
+    """vjp of conditional_block: both branches replayed under lax.cond at the
+    recorded pre-op carry; grads flow to explicit reads X and, for
+    pre-existing outputs, through the else-branch passthrough."""
+    sub = op_.attr("sub_block")
+    cond = ins["Cond"][0]
+    is_scalar_condition = bool(op_.attr("is_scalar_condition", True))
+    pred = jnp.asarray(cond).reshape(-1)[0].astype(bool) \
+        if is_scalar_condition else jnp.all(jnp.asarray(cond))
+
+    sr = ins["Scope"][0]
+    assert isinstance(sr, ScopeRecordVal), "cond grad needs recorded scope"
+    out_names = list(sr.names)
+    carry = dict(sr.values)
+    out_cts = dict(zip(op_.desc.inputs.get("Out", []),
+                       ins.get("Out@GRAD", [])))
+
+    x_names = op_.desc.inputs.get("X", [])
+    x_vals = dict(zip(x_names, ins.get("X", [])))
+    base_env = dict(ctx.env)
+    base_env.update({n: v for n, v in x_vals.items() if v is not None})
+
+    def _leafs_inexact(v):
+        return all(jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)
+                   for l in jax.tree.leaves(v))
+
+    xd_names = [n for n in x_names
+                if n not in carry and x_vals.get(n) is not None
+                and _leafs_inexact(x_vals[n])]
+    x_diff = {n: x_vals[n] for n in xd_names}
+
+    def pure(carry, xd):
+        return _cond_apply(ctx, sub.idx, base_env, out_names, pred,
+                           carry, xd)
+
+    out_primal, vjp_fn = jax.vjp(pure, carry, x_diff)
+    cts = []
+    for n, p in zip(out_names, out_primal):
+        p = jnp.asarray(p)
+        g = out_cts.get(n)
+        if not jnp.issubdtype(p.dtype, jnp.inexact):
+            cts.append(np.zeros(p.shape, dtype=jax.dtypes.float0))
+        elif g is not None:
+            cts.append(jnp.asarray(g, p.dtype))
+        else:
+            cts.append(jnp.zeros_like(p))
+    dc, dx = vjp_fn(cts)
+
+    gx_names = op_.desc.outputs.get("X@GRAD", [])
+    grads = []
+    for gn in gx_names:
+        base = gn.split("@RENAME@")[0]
+        if base.endswith("@GRAD"):
+            base = base[: -len("@GRAD")]
+        g = None
+        if base in x_diff:
+            g = dx[base]
+        if base in carry and _leafs_inexact(carry[base]):
+            c = dc[base]
+            if not (hasattr(c, "dtype") and c.dtype == jax.dtypes.float0):
+                g = c if g is None else g + c
+        grads.append(g)
+    return {"X@GRAD": grads}
+
+
+_registry_mod.get("conditional_block").grad = _conditional_block_grad_maker
 
 
 @op("rnn", no_kernel=True)

@@ -427,7 +427,8 @@ def _prelu(ctx, op_, ins):
     elif mode == "channel":
         a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
     else:
-        a = alpha.reshape(x.shape)
+        # element mode: alpha is [1, *feature_dims], broadcast over batch
+        a = alpha.reshape((1,) + tuple(x.shape[1:]))
     return {"Out": [jnp.where(x > 0, x, a * x)]}
 
 

@@ -288,9 +288,12 @@ class TestPrelu(OpTest):
     def test(self):
         x = (np.random.rand(3, 4) - 0.5).astype("float32")
         x[np.abs(x) < 0.05] = 0.1   # keep away from the kink
+        # element-mode alpha is [1, *feature_dims]: one alpha per feature
+        # element shared across the batch (a parameter cannot be sized by
+        # the -1 batch dim)
         for mode, a in (("all", np.array([0.25], "float32")),
                         ("channel", np.random.rand(4).astype("float32")),
-                        ("element", np.random.rand(3, 4).astype("float32"))):
+                        ("element", np.random.rand(1, 4).astype("float32"))):
             alpha = a.reshape(-1) if mode != "element" else a
             if mode == "all":
                 ab = a[0]
