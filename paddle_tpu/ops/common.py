@@ -37,6 +37,28 @@ def maybe_dense(v):
     return v.to_dense() if isinstance(v, SelectedRowsVal) else v
 
 
+def merge_selected_rows(sr: "SelectedRowsVal"):
+    """Merge duplicate rows by summation (reference
+    operators/math/selected_rows_functor.cc MergeAdd), keeping shapes
+    static: returns (rows [K], values [K, D...]) where duplicates are
+    summed into their first slot and freed slots carry row index =
+    height (out of range, so scatters drop them and gathers clamp
+    harmlessly). Cost O(K log K + K*D) — never materializes the dense
+    table, which is the point of the sparse optimizer path."""
+    rows = jnp.asarray(sr.rows)
+    vals = jnp.asarray(sr.values)
+    k = rows.shape[0]
+    order = jnp.argsort(rows)
+    r_s = rows[order]
+    v_s = vals[order]
+    is_new = jnp.concatenate(
+        [jnp.ones((1,), bool), r_s[1:] != r_s[:-1]])
+    seg = jnp.cumsum(is_new) - 1                       # [K] in [0, K)
+    merged_vals = jax.ops.segment_sum(v_s, seg, num_segments=k)
+    merged_rows = jnp.full((k,), sr.height, rows.dtype).at[seg].set(r_s)
+    return merged_rows, merged_vals
+
+
 def to_np_dtype(name: str):
     if name == "bfloat16":
         return jnp.bfloat16

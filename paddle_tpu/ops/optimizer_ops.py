@@ -13,7 +13,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .registry import NO_GRAD, op
-from .common import SelectedRowsVal, maybe_dense, in_var, set_out
+from .common import (SelectedRowsVal, maybe_dense, merge_selected_rows,
+                     in_var, set_out)
 
 
 def _param_out_infer(*pairs):
@@ -57,9 +58,24 @@ def _sgd(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"),
                                  ("Velocity", "VelocityOut")))
 def _momentum(ctx, op_, ins):
+    mu = op_.attr("mu")
+    g0 = ins["Grad"][0]
+    if isinstance(g0, SelectedRowsVal):
+        # SelectedRows fast path: velocity decays + param moves only on
+        # the gradient's rows (lazy semantics matching sparse adam below)
+        p = jnp.asarray(ins["Param"][0])
+        v = jnp.asarray(ins["Velocity"][0])
+        rows, gv = merge_selected_rows(g0)
+        gv = gv.astype(p.dtype)
+        v_out = mu * v[rows] + gv
+        if op_.attr("use_nesterov", False):
+            p_out = p[rows] - _lr(ins) * (gv + mu * v_out)
+        else:
+            p_out = p[rows] - _lr(ins) * v_out
+        return {"ParamOut": [p.at[rows].set(p_out, mode="drop")],
+                "VelocityOut": [v.at[rows].set(v_out, mode="drop")]}
     p, g = _param_grad(ins)
     v = jnp.asarray(ins["Velocity"][0])
-    mu = op_.attr("mu")
     v_out = mu * v + g
     if op_.attr("use_nesterov", False):
         p_out = p - _lr(ins) * (g + mu * v_out)
@@ -72,14 +88,36 @@ def _momentum(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment1", "Moment1Out"),
                                  ("Moment2", "Moment2Out")))
 def _adam(ctx, op_, ins):
-    p, g = _param_grad(ins)
-    m1 = jnp.asarray(ins["Moment1"][0])
-    m2 = jnp.asarray(ins["Moment2"][0])
-    b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
-    b2p = jnp.asarray(ins["Beta2Pow"][0]).reshape(())
     b1 = op_.attr("beta1", 0.9)
     b2 = op_.attr("beta2", 0.999)
     eps = op_.attr("epsilon", 1e-8)
+    b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
+    b2p = jnp.asarray(ins["Beta2Pow"][0]).reshape(())
+    g0 = ins["Grad"][0]
+    if isinstance(g0, SelectedRowsVal):
+        # SelectedRows fast path (reference adam_op.h SparseAdamFunctor):
+        # moments/param update only the gradient's rows; untouched rows
+        # keep stale moments, exactly like the reference. O(K*D) instead
+        # of the O(V*D) densified update — the difference between an
+        # embedding model training at batch cost vs vocab cost.
+        p = jnp.asarray(ins["Param"][0])
+        m1 = jnp.asarray(ins["Moment1"][0])
+        m2 = jnp.asarray(ins["Moment2"][0])
+        rows, gv = merge_selected_rows(g0)
+        gv = gv.astype(p.dtype)
+        m1r = m1[rows]
+        m2r = m2[rows]
+        m1o = b1 * m1r + (1 - b1) * gv
+        m2o = b2 * m2r + (1 - b2) * gv * gv
+        lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
+        po = p[rows] - lr * m1o / (jnp.sqrt(m2o) + eps)
+        # padded slots carry row==height: out-of-range scatters drop
+        return {"ParamOut": [p.at[rows].set(po, mode="drop")],
+                "Moment1Out": [m1.at[rows].set(m1o, mode="drop")],
+                "Moment2Out": [m2.at[rows].set(m2o, mode="drop")]}
+    p, g = _param_grad(ins)
+    m1 = jnp.asarray(ins["Moment1"][0])
+    m2 = jnp.asarray(ins["Moment2"][0])
     m1o = b1 * m1 + (1 - b1) * g
     m2o = b2 * m2 + (1 - b2) * g * g
     lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)

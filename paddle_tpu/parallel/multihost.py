@@ -23,8 +23,8 @@ import os
 import tempfile
 from typing import Callable, Iterable, Optional
 
-__all__ = ["initialize", "shard_reader", "save_checkpoint",
-           "load_checkpoint", "latest_checkpoint"]
+__all__ = ["initialize", "shard_reader", "CheckpointableReader",
+           "save_checkpoint", "load_checkpoint", "latest_checkpoint"]
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -71,22 +71,81 @@ def shard_reader(reader: Callable[[], Iterable], num_shards=None,
     return sharded
 
 
+class CheckpointableReader:
+    """Sample stream with a checkpointable position: (pass_id, offset) ride
+    the checkpoint metadata, so a restart resumes mid-pass — consumed
+    samples are neither replayed nor lost. This is the Go master's
+    task-queue snapshot/recover semantics (go/master/service.go:207
+    snapshot each mutation, :166 recover) collapsed onto the static-shard
+    reader: position IS the queue state when shards are deterministic.
+
+    Use as a reader factory: each call yields the remainder of the current
+    pass, then advances to the next pass starting at offset 0. Determinism
+    requirement: the wrapped factory must yield the same stream each pass
+    (shuffle via a pass_id-seeded RNG, e.g. reader.shuffle with a fixed
+    seed — same requirement the reference's chunk queue puts on recordio
+    files).
+
+    Prefetching caveat: position advances at yield time, so samples sitting
+    in a prefetch buffer (e.g. DoubleBufferedFeeder) count as consumed. A
+    checkpoint taken then would LOSE those in-flight samples on restart —
+    pass the buffer depth as `in_flight` to state()/save_checkpoint so the
+    recorded position backs up over them (restart re-reads them instead;
+    replaying an in-flight sample is safe, dropping it is not)."""
+
+    def __init__(self, reader_factory: Callable[[], Iterable]):
+        self.reader_factory = reader_factory
+        self.pass_id = 0
+        self.offset = 0
+
+    def state(self, in_flight: int = 0) -> dict:
+        off = max(0, self.offset - int(in_flight))
+        return {"reader_pass": self.pass_id, "reader_offset": off}
+
+    def restore(self, state: dict):
+        self.pass_id = int(state.get("reader_pass", 0))
+        self.offset = int(state.get("reader_offset", 0))
+
+    def __call__(self):
+        skip = self.offset
+        for i, sample in enumerate(self.reader_factory()):
+            if i < skip:
+                continue
+            # position advances BEFORE the consumer processes the sample:
+            # a checkpoint taken after a step records that step's samples
+            # as consumed (the reference marks a task done only on
+            # TaskFinished; here the executor step and the checkpoint are
+            # atomic w.r.t. each other because checkpoints happen between
+            # steps)
+            self.offset = i + 1
+            yield sample
+        self.pass_id += 1
+        self.offset = 0
+
+
 # --- checkpoint-restart -------------------------------------------------------
 
 _META = "checkpoint_meta.json"
 
 
 def save_checkpoint(executor, dirname: str, step: int, main_program=None,
-                    extra_meta: Optional[dict] = None):
+                    extra_meta: Optional[dict] = None, reader=None,
+                    reader_in_flight: int = 0):
     """Persistables + step metadata, written atomically (temp file + rename)
     so a crash mid-write never corrupts the latest checkpoint — the
     md5+meta discipline of the Go pserver checkpoints
-    (go/pserver/service.go:120-203)."""
+    (go/pserver/service.go:120-203). Pass a CheckpointableReader as
+    `reader` to capture the data-stream position too (mid-pass resume);
+    `reader_in_flight` = number of samples sitting in prefetch buffers
+    between the reader and the training step (they get re-read on
+    restart rather than lost)."""
     from .. import io as io_mod
     ckpt_dir = os.path.join(dirname, f"step_{step}")
     os.makedirs(ckpt_dir, exist_ok=True)
     io_mod.save_persistables(executor, ckpt_dir, main_program=main_program)
     meta = {"step": step, **(extra_meta or {})}
+    if reader is not None:
+        meta.update(reader.state(in_flight=reader_in_flight))
     fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".meta.tmp")
     with os.fdopen(fd, "w") as f:
         json.dump(meta, f)
@@ -104,14 +163,19 @@ def latest_checkpoint(dirname: str) -> Optional[dict]:
     return meta if os.path.isdir(ckpt_dir) else None
 
 
-def load_checkpoint(executor, dirname: str, main_program=None) -> Optional[dict]:
+def load_checkpoint(executor, dirname: str, main_program=None,
+                    reader=None) -> Optional[dict]:
     """Restore persistables from the newest checkpoint; returns its metadata
     (with 'step') or None when no checkpoint exists — the trainer resumes
-    at meta['step'] + 1 (master recover parity, go/master/service.go:166)."""
+    at meta['step'] + 1 (master recover parity, go/master/service.go:166).
+    With `reader` (a CheckpointableReader), the data-stream position is
+    restored too, so the resumed pass continues exactly where it stopped."""
     from .. import io as io_mod
     meta = latest_checkpoint(dirname)
     if meta is None:
         return None
     ckpt_dir = os.path.join(dirname, f"step_{meta['step']}")
     io_mod.load_persistables(executor, ckpt_dir, main_program=main_program)
+    if reader is not None:
+        reader.restore(meta)
     return meta

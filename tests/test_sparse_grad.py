@@ -90,3 +90,87 @@ class TestSparseEmbeddingGrad:
                 first = first or float(np.asarray(v).reshape(-1)[0])
             last = float(np.asarray(v).reshape(-1)[0])
         assert last < first * 0.5, (first, last)
+
+
+def _train_opt(opt_factory, is_sparse, steps=3):
+    """Shared net under a given optimizer: exercises the SelectedRows
+    kernels (reference adam_op.h SparseAdamFunctor, momentum extension)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[4], dtype="int64")
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[50, 8], is_sparse=is_sparse,
+                                     param_attr=fluid.ParamAttr(name="emb_w"))
+        flat = fluid.layers.reshape(emb, shape=[-1, 32])
+        logits = fluid.layers.fc(input=flat, size=50,
+                                 param_attr=fluid.ParamAttr(name="fc_w"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, lbl))
+        opt_factory().minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    feed = {"ids": np.array([[1, 7, 7, 3], [0, 2, 2, 2]], np.int64),
+            "lbl": np.array([[5], [9]], np.int64)}
+    with executor_mod.scope_guard(scope):
+        exe.run(startup)
+        scope.set_var("emb_w", np.linspace(
+            -1, 1, 50 * 8).astype(np.float32).reshape(50, 8))
+        scope.set_var("fc_w", np.linspace(
+            -0.5, 0.5, 32 * 50).astype(np.float32).reshape(32, 50))
+        losses = []
+        for _ in range(steps):
+            v, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(v).reshape(-1)[0]))
+        w = np.asarray(scope.find_var("emb_w"))
+    return losses, w
+
+
+def _train_adam(is_sparse, steps=3):
+    return _train_opt(lambda: fluid.optimizer.Adam(learning_rate=0.1),
+                      is_sparse, steps)
+
+
+def _train_momentum(is_sparse, steps=3):
+    return _train_opt(
+        lambda: fluid.optimizer.Momentum(learning_rate=0.3, momentum=0.9),
+        is_sparse, steps)
+
+
+class TestSparseAdam:
+    """Sparse adam semantics (reference adam_op.h sparse path): touched rows
+    match... nothing — sparse adam is intentionally NOT equal to dense adam:
+    dense adam decays every row's moments each step, sparse (lazy) only
+    touches grad rows. Assert (a) the first step matches dense exactly
+    (moments start at zero, so laziness is invisible), (b) untouched rows
+    never move, (c) multi-step training still converges."""
+
+    def test_first_step_matches_dense(self):
+        l_d, w_d = _train_adam(is_sparse=False, steps=1)
+        l_s, w_s = _train_adam(is_sparse=True, steps=1)
+        np.testing.assert_allclose(l_s, l_d, rtol=1e-5)
+        np.testing.assert_allclose(w_s, w_d, rtol=1e-5, atol=1e-6)
+
+    def test_untouched_rows_frozen_and_trains(self):
+        losses, w = _train_adam(is_sparse=True, steps=6)
+        init = np.linspace(-1, 1, 50 * 8).astype(np.float32).reshape(50, 8)
+        touched = {0, 1, 2, 3, 7}
+        untouched = [i for i in range(50) if i not in touched]
+        np.testing.assert_array_equal(w[untouched], init[untouched])
+        assert losses[-1] < losses[0], losses
+
+
+class TestSparseMomentum:
+    def test_first_step_matches_dense(self):
+        l_d, w_d = _train_momentum(is_sparse=False, steps=1)
+        l_s, w_s = _train_momentum(is_sparse=True, steps=1)
+        np.testing.assert_allclose(l_s, l_d, rtol=1e-5)
+        np.testing.assert_allclose(w_s, w_d, rtol=1e-5, atol=1e-6)
+
+    def test_untouched_rows_frozen_and_trains(self):
+        losses, w = _train_momentum(is_sparse=True, steps=6)
+        init = np.linspace(-1, 1, 50 * 8).astype(np.float32).reshape(50, 8)
+        touched = {0, 1, 2, 3, 7}
+        untouched = [i for i in range(50) if i not in touched]
+        np.testing.assert_array_equal(w[untouched], init[untouched])
+        assert losses[-1] < losses[0], losses
