@@ -11,6 +11,8 @@ from .tensor_parallel import (shard_parameter, shard_fc_params,
 from . import ring_attention
 from . import pipeline
 from .pipeline import gpipe
+from . import program_pipeline
+from .program_pipeline import PipelineTranspiler
 from .ring_attention import ring_attention_sharded
 
 

@@ -1,0 +1,309 @@
+"""Program-level pipeline parallelism: split a fluid Program into stage
+sub-programs on the IR and train them under a GPipe microbatch schedule.
+
+Reference ancestor: ParallelNeuralNetwork's layer-to-device assignment
+(gserver/gradientmachines/ParallelNeuralNetwork.h) — whole layers pinned to
+devices, activations shipped between them. Here the split happens on the
+ProgramDesc: the user names the boundary (cut) variables, each stage becomes
+a pruned sub-program (framework.prune dead-op elimination scoped to that
+stage's slice), per-stage gradients are IR-level vjp programs built with
+calc_gradient / append_backward, and per-stage optimizer-update programs are
+emitted through the normal Optimizer pass. Execution runs the classic GPipe
+schedule (forward all microbatches in tick order across per-stage devices,
+then backward in reverse, accumulate, apply) — jax async dispatch overlaps
+stage s of microbatch m with stage s+1 of microbatch m-1, which is the
+pipeline. The homogeneous-stack SPMD variant lives in pipeline.py (gpipe);
+this module is the heterogeneous Program/transpiler surface over it.
+
+Numerics contract: with per-microbatch mean losses and equal microbatch
+sizes, averaging the per-microbatch parameter gradients equals the
+full-batch gradient, so losses match single-device training exactly
+(tested on the 8-device CPU mesh, tests/test_program_pipeline.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PipelineTranspiler"]
+
+
+def _var_names(v_or_list):
+    from ..framework.framework import Variable
+    if isinstance(v_or_list, (list, tuple)):
+        return [v.name if isinstance(v, Variable) else str(v)
+                for v in v_or_list]
+    v = v_or_list
+    return [v.name if isinstance(v, Variable) else str(v)]
+
+
+class _Stage:
+    def __init__(self, idx, fwd_prog, grad_prog, update_prog, update_startup,
+                 in_name, out_name, feed_names, param_names, grad_feed_name,
+                 place):
+        self.idx = idx
+        self.fwd_prog = fwd_prog            # feeds -> out boundary (+loss)
+        self.grad_prog = grad_prog          # feeds + cotangent -> grads
+        self.update_prog = update_prog      # grad feeds -> param updates
+        self.update_startup = update_startup
+        self.in_name = in_name              # boundary var consumed (or None)
+        self.out_name = out_name            # boundary var produced (or loss)
+        self.feed_names = feed_names        # data vars this stage consumes
+        self.param_names = param_names
+        self.grad_feed_name = grad_feed_name  # cotangent feed (None on last)
+        self.place = place
+
+
+class PipelineTranspiler:
+    """Split a program at named cut variables into pipeline stages.
+
+    Usage (mirrors DistributeTranspiler's transpile-then-get pattern):
+
+        t = PipelineTranspiler()
+        trainer = t.transpile(loss, cut_vars=[h1, h2, h3],
+                              optimizer=lambda: fluid.optimizer.SGD(0.1),
+                              num_microbatches=4)
+        exe_places = ...         # optional per-stage Places
+        trainer.startup(startup_program)
+        loss_val = trainer.train_step(feed={"x": ..., "y": ...})
+
+    cut_vars define P = len(cut_vars)+1 stages: stage i computes from cut
+    i-1 (or the feeds) up to cut i; the last stage ends at the loss.
+    """
+
+    def transpile(self, loss, cut_vars: Sequence, optimizer: Callable,
+                  num_microbatches: int, main_program=None,
+                  places: Optional[Sequence] = None):
+        from .. import layers
+        from ..backward import append_backward, calc_gradient
+        from ..clip import append_gradient_clip_ops
+        from ..executor import CPUPlace
+        from ..framework.framework import (Parameter, grad_var_name,
+                                           program_guard)
+        from ..regularizer import append_regularization_ops
+
+        program = main_program or loss.block.program
+        cut_names = _var_names(list(cut_vars))
+        loss_name = loss.name
+        block = program.global_block()
+        src_params = {p.name: p for p in block.all_parameters()}
+        param_names_all = set(src_params)
+        # non-trainable params stay frozen: excluded from grads and updates
+        # exactly like append_backward's trainable filter in minimize()
+        trainable = {n for n, p in src_params.items()
+                     if getattr(p, "trainable", True)}
+        data_names = self._feed_var_names(program)
+
+        n_stages = len(cut_names) + 1
+        boundaries = [None] + cut_names              # input boundary per stage
+        targets = cut_names + [loss_name]            # output per stage
+
+        stages: List[_Stage] = []
+        for i in range(n_stages):
+            in_name = boundaries[i]
+            out_name = targets[i]
+            feeds = ([in_name] if in_name else []) + list(data_names) \
+                + sorted(param_names_all)
+            fwd = program.prune(feeds=feeds, fetches=[out_name])
+            fblock = fwd.global_block()
+            stage_params = sorted(
+                {n for op in fblock.ops for n in op.input_arg_names
+                 if n in trainable})
+            stage_feeds = sorted(
+                {n for op in fblock.ops for n in op.input_arg_names
+                 if n in data_names})
+
+            # gradient program: stage forward + IR-level vjp
+            grad = fwd.clone()
+            gblock = grad.global_block()
+            grad_feed_name = None
+            with program_guard(grad):
+                if i == n_stages - 1:
+                    append_backward(gblock.var(out_name))
+                else:
+                    gvar = layers.data(
+                        name=f"{out_name}@PIPE_CT", shape=[1],
+                        dtype=gblock.var(out_name).dtype,
+                        append_batch_size=False, stop_gradient=True)
+                    grad_feed_name = gvar.name
+                    wrt = ([in_name] if in_name else []) + stage_params
+                    calc_gradient(gblock.var(out_name),
+                                  [gblock.var(n) for n in wrt],
+                                  target_gradients=gvar)
+
+            # optimizer-update program: grads arrive as feeds
+            from ..framework.framework import Program
+            update = Program()
+            update_startup = Program()
+            with program_guard(update, update_startup):
+                ublock = update.global_block()
+                pg = []
+                for pn in stage_params:
+                    src = src_params[pn]
+                    p = Parameter(ublock, name=pn, shape=src.shape,
+                                  dtype=src.dtype)
+                    # per-param optimizer semantics must survive the
+                    # rebuild (lr scale, weight decay, clipping)
+                    p.trainable = getattr(src, "trainable", True)
+                    p.optimize_attr = dict(
+                        getattr(src, "optimize_attr", None)
+                        or {"learning_rate": 1.0})
+                    p.regularizer = getattr(src, "regularizer", None)
+                    p.gradient_clip_attr = getattr(
+                        src, "gradient_clip_attr", None)
+                    g = ublock.create_var(name=grad_var_name(pn),
+                                          shape=src.shape, dtype=src.dtype)
+                    pg.append((p, g))
+                if pg:
+                    opt = optimizer()
+                    # same post-processing minimize() applies
+                    pg = append_gradient_clip_ops(pg)
+                    pg = append_regularization_ops(pg, opt.regularization)
+                    opt._create_optimization_pass(pg, pg[0][0],
+                                                  update_startup)
+            place = None
+            if places is not None:
+                place = places[i % len(places)]
+            stages.append(_Stage(i, fwd, grad, update, update_startup,
+                                 in_name, out_name, stage_feeds,
+                                 stage_params, grad_feed_name,
+                                 place or CPUPlace(i)))
+
+        # cut vars must be graph separators: a param reachable from two
+        # stages (skip connection across a cut, or cuts out of topological
+        # order) would get its optimizer update applied once per owning
+        # stage — reject loudly instead of silently double-stepping.
+        seen: Dict[str, int] = {}
+        for s in stages:
+            for pn in s.param_names:
+                if pn in seen:
+                    raise ValueError(
+                        f"Parameter '{pn}' is used by pipeline stages "
+                        f"{seen[pn]} and {s.idx}: the cut variables "
+                        f"{cut_names} do not separate the graph (skip "
+                        f"connection across a cut?). Choose cuts so every "
+                        f"parameter belongs to exactly one stage.")
+                seen[pn] = s.idx
+        return PipelineTrainer(stages, num_microbatches, loss_name)
+
+    @staticmethod
+    def _feed_var_names(program) -> List[str]:
+        """Data vars = root-block vars nobody produces and that are not
+        parameters/persistable (the feed surface)."""
+        block = program.global_block()
+        produced = {n for op in block.ops for n in op.output_arg_names}
+        params = {p.name for p in block.all_parameters()}
+        names = []
+        for name in block.desc.vars:
+            v = block.var(name)
+            if name in produced or name in params:
+                continue
+            if getattr(v.desc, "persistable", False):
+                continue
+            if any(name in op.input_arg_names for op in block.ops):
+                names.append(name)
+        return names
+
+
+class PipelineTrainer:
+    """GPipe execution of transpiled stages: forward all microbatches in
+    tick order, backward reversed, average grads, apply updates."""
+
+    def __init__(self, stages: List[_Stage], num_microbatches: int,
+                 loss_name: str):
+        from ..executor import Executor
+        self.stages = stages
+        self.m = num_microbatches
+        self.loss_name = loss_name
+        self.executors = [Executor(s.place) for s in stages]
+
+    def startup(self, startup_program, scope=None):
+        """Run the model's startup once (params init) + each stage's
+        optimizer-startup (accumulators, lr vars)."""
+        self.executors[0].run(startup_program, scope=scope)
+        for s, exe in zip(self.stages, self.executors):
+            exe.run(s.update_startup, scope=scope)
+
+    def _split_feed(self, feed: Dict[str, np.ndarray]):
+        m = self.m
+        micro = [dict() for _ in range(m)]
+        for name, val in feed.items():
+            val = np.asarray(val)
+            assert val.shape[0] % m == 0, (
+                f"batch {val.shape[0]} not divisible into {m} microbatches")
+            step = val.shape[0] // m
+            for j in range(m):
+                micro[j][name] = val[j * step: (j + 1) * step]
+        return micro
+
+    def train_step(self, feed: Dict[str, np.ndarray], scope=None):
+        """One synchronized pipeline step over the full batch; returns the
+        mean loss across microbatches."""
+        from ..framework.framework import grad_var_name
+
+        stages, m = self.stages, self.m
+        p = len(stages)
+        micro = self._split_feed(feed)
+
+        # forward in GPipe tick order: async dispatch overlaps devices
+        acts = [[None] * p for _ in range(m)]   # boundary outputs
+        losses = [None] * m
+        for t in range(m + p - 1):
+            for si in range(p):
+                j = t - si
+                if not (0 <= j < m):
+                    continue
+                s, exe = stages[si], self.executors[si]
+                f = {k: v for k, v in micro[j].items()
+                     if k in s.feed_names}
+                if s.in_name:
+                    f[s.in_name] = acts[j][si - 1]
+                out, = exe.run(s.fwd_prog, feed=f,
+                               fetch_list=[s.out_name], scope=scope,
+                               return_numpy=False)
+                acts[j][si] = out
+                if si == p - 1:
+                    losses[j] = out
+
+        # backward: reverse ticks; cotangents flow right-to-left
+        grad_acc: Dict[str, object] = {}
+        cts = [None] * m                         # cotangent entering stage si
+        for t in range(m + p - 1):
+            for si in range(p - 1, -1, -1):
+                j = t - (p - 1 - si)
+                if not (0 <= j < m):
+                    continue
+                s, exe = stages[si], self.executors[si]
+                f = {k: v for k, v in micro[j].items()
+                     if k in s.feed_names}
+                if s.in_name:
+                    f[s.in_name] = acts[j][si - 1]
+                if s.grad_feed_name:
+                    f[s.grad_feed_name] = cts[j]
+                fetch = [grad_var_name(pn) for pn in s.param_names]
+                if s.in_name:
+                    fetch = [grad_var_name(s.in_name)] + fetch
+                vals = exe.run(s.grad_prog, feed=f, fetch_list=fetch,
+                               scope=scope, return_numpy=False)
+                if s.in_name:
+                    cts[j] = vals[0]
+                    gvals = vals[1:]
+                else:
+                    gvals = vals
+                for pn, gv in zip(s.param_names, gvals):
+                    cur = grad_acc.get(pn)
+                    grad_acc[pn] = gv if cur is None else cur + gv
+
+        # apply: mean of microbatch grads == full-batch grad (mean losses)
+        inv_m = 1.0 / m
+        for s, exe in zip(stages, self.executors):
+            if not s.param_names:
+                continue
+            gfeed = {grad_var_name(pn): grad_acc[pn] * inv_m
+                     for pn in s.param_names}
+            exe.run(s.update_prog, feed=gfeed, fetch_list=[], scope=scope)
+
+        return float(np.mean([float(np.asarray(l).ravel()[0])
+                              for l in losses]))
