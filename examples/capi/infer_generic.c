@@ -1,0 +1,74 @@
+/* Generic C inference driver: load any single-float-input model saved by
+ * fluid.io.save_inference_model and run one forward pass (reference:
+ * paddle/capi/examples/model_inference/dense/main.c generalized — the
+ * conv and sequence book models go through this same path).
+ *
+ * Usage: infer_generic <model_dir> <input_name> d0 d1 [d2 [d3]]
+ * The input tensor is filled with the deterministic pattern
+ * x[i] = sin(0.01 * i) so the Python side can reproduce it exactly.
+ *
+ * Build:
+ *   gcc infer_generic.c -I paddle_tpu/native -L paddle_tpu/native \
+ *       -lpaddle_tpu_capi -lm -o infer_generic
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "capi.h"
+
+#define CHECK(stmt)                                          \
+  do {                                                       \
+    paddle_error e__ = (stmt);                               \
+    if (e__ != PD_NO_ERROR) {                                \
+      fprintf(stderr, "error %d at %s\n", e__, #stmt);       \
+      return 1;                                              \
+    }                                                        \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s <model_dir> <input_name> d0 d1 [d2 [d3]]\n",
+            argv[0]);
+    return 2;
+  }
+  int ndim_in = argc - 3;
+  if (ndim_in > 4) ndim_in = 4;
+  int64_t dims[4];
+  int64_t numel = 1;
+  int d;
+  for (d = 0; d < ndim_in; ++d) {
+    dims[d] = atoll(argv[3 + d]);
+    numel *= dims[d];
+  }
+
+  CHECK(paddle_tpu_init());
+  paddle_tpu_machine machine;
+  CHECK(paddle_tpu_machine_create(&machine, argv[1]));
+
+  float* x = (float*)malloc(sizeof(float) * (size_t)numel);
+  int64_t i;
+  for (i = 0; i < numel; ++i) x[i] = (float)sin(0.01 * (double)i);
+  CHECK(paddle_tpu_machine_set_input(machine, argv[2], x, dims, ndim_in));
+  free(x);
+
+  CHECK(paddle_tpu_machine_forward(machine));
+
+  int count = 0;
+  CHECK(paddle_tpu_machine_output_count(machine, &count));
+  const float* out;
+  const int64_t* out_dims;
+  int ndim;
+  CHECK(paddle_tpu_machine_get_output(machine, 0, &out, &out_dims, &ndim));
+  int64_t total = 1;
+  printf("outputs=%d ndim=%d shape=[", count, ndim);
+  for (d = 0; d < ndim; ++d) {
+    total *= out_dims[d];
+    printf(d ? ",%lld" : "%lld", (long long)out_dims[d]);
+  }
+  printf("]\n");
+  for (i = 0; i < total; ++i) printf("out[%lld]=%.6f\n", (long long)i, out[i]);
+
+  CHECK(paddle_tpu_machine_destroy(machine));
+  return 0;
+}

@@ -43,6 +43,7 @@ from . import profiler
 from . import amp
 from . import compat
 from . import metrics
+from . import average
 from .parallel import transpiler
 from .parallel.transpiler import DistributeTranspiler
 

@@ -78,6 +78,7 @@ _ZERO_GRAD_SAFE = frozenset({
     "print", "one_hot", "uniform_random", "gaussian_random",
     "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
     "sign", "arg_max", "arg_min", "crf_decoding", "ctc_align",
+    "sequence_mask", "prior_box",
 })
 
 _INT_DTYPES = ("bool", "int8", "uint8", "int16", "int32", "int64")
@@ -140,7 +141,7 @@ def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
         type="fill_constant",
         outputs={"Out": [loss_g]},
         attrs={"shape": list(loss.shape or [1]), "value": 1.0,
-               "dtype": loss.dtype})
+               "dtype": loss.dtype, "op_role": "backward"})
 
     produced_count: Dict[str, int] = {loss_g: 1}
     grad_to_var: Dict[str, str] = {loss_g: loss.name}
@@ -182,6 +183,9 @@ def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
                     base = n.split("@RENAME@")[0]
                     if base.endswith("@GRAD"):
                         grad_to_var[base] = base[: -len("@GRAD")]
+            # role tag (reference OpRole::kBackward): inference slicing
+            # (io.get_inference_program) strips these before pruning
+            g.attrs.setdefault("op_role", "backward")
             block.desc.ops.append(g)
             from .framework.framework import Operator
             op_obj = Operator(block, g)
@@ -191,7 +195,8 @@ def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
 
             for orig, rn in renames:
                 block.append_op(type="sum", inputs={"X": [orig, rn]},
-                                outputs={"Out": [orig]})
+                                outputs={"Out": [orig]},
+                                attrs={"op_role": "backward"})
 
     program.grad_info_map.update(grad_to_var)
 

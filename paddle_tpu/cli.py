@@ -122,6 +122,94 @@ def cmd_time(args):
     return 0
 
 
+def cmd_checkgrad(args):
+    """--job=checkgrad parity (reference TrainerMain.cpp:36): numeric
+    central-difference gradients of the config's loss w.r.t. every
+    parameter, compared against the analytic grads the IR backward pass
+    emits. Optimizer-role ops are stripped so repeated loss evaluations
+    never mutate the parameters."""
+    import numpy as np
+    import paddle_tpu as fluid
+    import paddle_tpu.minibatch as minibatch
+    from paddle_tpu import executor as executor_mod
+    from paddle_tpu.framework.framework import grad_var_name
+
+    from paddle_tpu.io import _strip_training_ops
+
+    cfg = _load_config(args.config)
+    spec = cfg.build()
+    main = spec["main_program"]
+    block = main.global_block()
+    # forward + backward (no optimizer updates) for the analytic grads;
+    # forward-only for the many numeric loss evaluations — the executor
+    # compiles whole programs regardless of fetch list, so evaluating the
+    # loss on the fwd+bwd program would recompute every gradient 2*samples
+    # times per parameter
+    check = main.clone()
+    cb = check.global_block()
+    cb.desc.ops = [d for d in cb.desc.ops
+                   if d.attrs.get("op_role") != "optimize"]
+    cb._sync_ops()
+    fwd_only = _strip_training_ops(main)
+
+    params = sorted(p.name for p in block.all_parameters())
+    grads = [grad_var_name(p) for p in params]
+    missing = [g for g in grads if not cb.has_var(g)]
+    if missing:
+        raise SystemExit(
+            f"checkgrad needs analytic grads in the program; missing "
+            f"{missing} (did build() call minimize()?)")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(spec["startup_program"])
+    feeder = _feeder(fluid, cfg, spec)
+    batched = minibatch.batch(cfg.train_reader, batch_size=args.batch_size)
+    feed = feeder.feed(next(iter(batched())))
+    loss_name = spec["loss"].name
+    scope = executor_mod.global_scope()
+
+    def run_loss():
+        out, = exe.run(fwd_only, feed=feed, fetch_list=[loss_name])
+        return float(np.ravel(out)[0])
+
+    outs = exe.run(check, feed=feed, fetch_list=[loss_name] + grads)
+    analytic = {p: np.asarray(g) for p, g in zip(params, outs[1:])}
+
+    rng = np.random.RandomState(0)
+    delta, worst, failed = args.delta, 0.0, []
+    for p in params:
+        w = np.array(scope.find_var(p), np.float64)
+        flat = w.reshape(-1)
+        k = min(args.samples, flat.size)
+        idxs = rng.choice(flat.size, size=k, replace=False)
+        for i in idxs:
+            orig = flat[i]
+            flat[i] = orig + delta
+            scope.set_var(p, w.astype(np.float32))
+            lp = run_loss()
+            flat[i] = orig - delta
+            scope.set_var(p, w.astype(np.float32))
+            lm = run_loss()
+            flat[i] = orig
+            scope.set_var(p, w.astype(np.float32))
+            num = (lp - lm) / (2 * delta)
+            ana = float(analytic[p].reshape(-1)[i])
+            err = abs(num - ana) / max(abs(num), abs(ana), 1.0)
+            worst = max(worst, err)
+            if err > args.rtol:
+                failed.append((p, int(i), num, ana, err))
+        print(f"checkgrad {p}: {k} elements ok "
+              f"(max rel err so far {worst:.2e})")
+    if failed:
+        for p, i, num, ana, err in failed:
+            print(f"FAIL {p}[{i}]: numeric {num:.6g} vs analytic "
+                  f"{ana:.6g} (rel err {err:.2e})")
+        return 1
+    print(f"checkgrad PASSED: {len(params)} parameters, "
+          f"max rel err {worst:.2e}")
+    return 0
+
+
 def cmd_infer(args):
     import numpy as np
     import paddle_tpu as fluid
@@ -168,6 +256,16 @@ def main(argv=None):
     p_time.add_argument("--steps", type=int, default=20)
     p_time.add_argument("--batch-size", type=int, default=32)
     p_time.set_defaults(fn=cmd_time)
+
+    p_cg = sub.add_parser(
+        "checkgrad", help="numeric-vs-analytic gradient check of a config")
+    p_cg.add_argument("--config", required=True)
+    p_cg.add_argument("--batch-size", type=int, default=8)
+    p_cg.add_argument("--delta", type=float, default=5e-3)
+    p_cg.add_argument("--samples", type=int, default=4,
+                      help="elements checked per parameter")
+    p_cg.add_argument("--rtol", type=float, default=5e-2)
+    p_cg.set_defaults(fn=cmd_checkgrad)
 
     p_infer = sub.add_parser("infer", help="run a saved inference model")
     p_infer.add_argument("--model-dir", required=True)

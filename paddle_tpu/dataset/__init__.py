@@ -3,8 +3,9 @@ download+cache). Zero-egress build: each module serves a deterministic
 synthetic surrogate with the real schema unless real files are present
 under common.DATA_HOME (see common.py)."""
 
-from . import (cifar, common, conll05, imdb, imikolov, mnist, movielens,
-               uci_housing, wmt14)
+from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
+               movielens, sentiment, uci_housing, voc2012, wmt14, wmt16)
 
-__all__ = ["cifar", "common", "conll05", "imdb", "imikolov", "mnist",
-           "movielens", "uci_housing", "wmt14"]
+__all__ = ["cifar", "common", "conll05", "flowers", "imdb", "imikolov",
+           "mnist", "movielens", "sentiment", "uci_housing", "voc2012",
+           "wmt14", "wmt16"]

@@ -1,0 +1,42 @@
+"""Flowers-102 (reference: python/paddle/v2/dataset/flowers.py). Schema:
+(3*224*224 float32 image in [0,1], int64 label in [0,102)). Synthetic
+surrogate: per-class hue blob on a textured background, generated lazily
+per sample so the 224x224 images never materialize as one big array."""
+
+from __future__ import annotations
+
+import numpy as np
+
+CLASS_NUM = 102
+_TRAIN_N, _TEST_N, _VALID_N = 512, 128, 128
+_H = _W = 224
+
+
+def _sample(rng, classes):
+    label = int(rng.randint(0, classes))
+    img = rng.rand(3, _H, _W).astype(np.float32) * 0.2
+    ch = label % 3
+    r0 = (label * 37) % (_H - 64)
+    c0 = (label * 53) % (_W - 64)
+    img[ch, r0:r0 + 64, c0:c0 + 64] += 0.7
+    return np.clip(img, 0, 1).reshape(-1), label
+
+
+def _reader(n, seed, classes=CLASS_NUM):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            yield _sample(rng, classes)
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader(_TRAIN_N, 0)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader(_TEST_N, 1)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader(_VALID_N, 2)

@@ -1,0 +1,36 @@
+"""NLTK movie-review sentiment corpus (reference:
+python/paddle/v2/dataset/sentiment.py). Schema: (word-id sequence, label
+0/1 = negative/positive). Synthetic surrogate: sentiment-biased vocab
+regions (same construction as the imdb surrogate, smaller vocab)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+_VOCAB = 2048
+
+
+def get_word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            ln = int(rng.randint(10, 50))
+            lo = 2 + label * (_VOCAB // 2)
+            hi = lo + _VOCAB // 2 - 2
+            yield rng.randint(lo, hi, ln).tolist(), label
+    return reader
+
+
+def train():
+    return _reader(NUM_TRAINING_INSTANCES, 0)
+
+
+def test():
+    return _reader(NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES, 1)

@@ -1,0 +1,46 @@
+"""Pascal VOC2012 segmentation (reference:
+python/paddle/v2/dataset/voc2012.py). Schema: (image [3,H,W] float32,
+segmentation mask [H,W] int32 with 21 classes). Synthetic surrogate:
+rectangles of class-colored regions on a background, 64x64 so the suite
+stays light while keeping the (image, dense-mask) contract."""
+
+from __future__ import annotations
+
+import numpy as np
+
+CLASS_NUM = 21          # 20 object classes + background
+_TRAIN_N, _TEST_N, _VALID_N = 256, 64, 64
+_H = _W = 64
+
+
+def _sample(rng):
+    img = rng.rand(3, _H, _W).astype(np.float32) * 0.2
+    mask = np.zeros((_H, _W), np.int32)
+    for _ in range(int(rng.randint(1, 4))):
+        c = int(rng.randint(1, CLASS_NUM))
+        h, w = int(rng.randint(8, 32)), int(rng.randint(8, 32))
+        r0 = int(rng.randint(0, _H - h))
+        c0 = int(rng.randint(0, _W - w))
+        mask[r0:r0 + h, c0:c0 + w] = c
+        img[c % 3, r0:r0 + h, c0:c0 + w] += 0.5 + 0.02 * c
+    return np.clip(img, 0, 1), mask
+
+
+def _reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            yield _sample(rng)
+    return reader
+
+
+def train():
+    return _reader(_TRAIN_N, 0)
+
+
+def test():
+    return _reader(_TEST_N, 1)
+
+
+def val():
+    return _reader(_VALID_N, 2)

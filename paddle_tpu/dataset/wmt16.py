@@ -1,0 +1,46 @@
+"""WMT-16 en-de (reference: python/paddle/v2/dataset/wmt16.py). Schema
+matches the reference's BPE-token loaders: (src_ids, trg_ids_with_<s>,
+trg_ids_next_with_<e>) int64 sequences, with per-language dict sizes.
+Synthetic surrogate reuses the wmt14 construction (deterministic
+learnable mapping) with independent source/target vocab sizes."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_START, _END, _UNK = 0, 1, 2
+_TRAIN_N, _TEST_N, _VALID_N = 2048, 256, 256
+
+
+def _reader(n, src_dict_size, trg_dict_size, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            ln = int(rng.randint(3, 12))
+            src = rng.randint(3, src_dict_size, ln).tolist()
+            trg = [(src[0] * 3 + 1) % (trg_dict_size - 3) + 3]
+            for _k in range(ln - 1):
+                trg.append((trg[-1] * 5 + 7) % (trg_dict_size - 3) + 3)
+            yield src, [_START] + trg, trg + [_END]
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader(_TRAIN_N, src_dict_size, trg_dict_size, 0)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader(_TEST_N, src_dict_size, trg_dict_size, 1)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _reader(_VALID_N, src_dict_size, trg_dict_size, 2)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {"<s>": _START, "<e>": _END, "<unk>": _UNK}
+    for i in range(3, dict_size):
+        d[f"{lang}{i}"] = i
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d

@@ -271,6 +271,14 @@ class LoweringContext:
 _EAGER = os.environ.get("PADDLE_TPU_EAGER", "0") == "1"
 _CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
 
+# FP-exception trapping (reference TrainerMain.cpp:49 feenableexcept
+# FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW): the XLA-world equivalent is
+# jax's debug-nans mode — any op producing NaN/Inf raises at the op that
+# made it (de-optimizes to op-by-op execution, debug only).
+if os.environ.get("PADDLE_TPU_TRAP_FP", "0") == "1":
+    jax.config.update("jax_debug_nans", True)
+    jax.config.update("jax_debug_infs", True)
+
 # op-coverage recorder (tools/op_coverage.py): append every executed op type
 # to the named file so a test sweep can prove each registered op runs
 _RECORD_OPS_PATH = os.environ.get("PADDLE_TPU_RECORD_OPS")

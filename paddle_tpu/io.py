@@ -119,11 +119,27 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
               load_file_name=filename)
 
 
+def _strip_training_ops(program):
+    """Drop backward/optimize-role ops before inference pruning (reference
+    inference_optimize + OpRole attr, prune.cc:187): without this, a fetch
+    var built AFTER minimize() (e.g. a crf_decoding path) sees the
+    optimizer's in-place ParamOut as the parameter's producer and the
+    reverse prune drags the whole training tail into the inference slice."""
+    p = program.clone()
+    for b in p.blocks:
+        b.desc.ops = [d for d in b.desc.ops
+                      if d.attrs.get("op_role") not in ("backward",
+                                                        "optimize")]
+        b._sync_ops()
+    return p
+
+
 def get_inference_program(target_vars, main_program=None):
     main_program = main_program or default_main_program()
     if not isinstance(target_vars, list):
         target_vars = [target_vars]
-    pruned = main_program.prune([], [t.name for t in target_vars])
+    forward = _strip_training_ops(main_program)
+    pruned = forward.prune([], [t.name for t in target_vars])
     return pruned.clone(for_test=True)
 
 
@@ -140,8 +156,8 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
     if not isinstance(target_vars, list):
         target_vars = [target_vars]
     os.makedirs(dirname, exist_ok=True)
-    pruned = main_program.prune(feeded_var_names,
-                                [t.name for t in target_vars])
+    pruned = _strip_training_ops(main_program).prune(
+        feeded_var_names, [t.name for t in target_vars])
     inference_program = pruned.clone(for_test=True)
     # feeds the targets do not depend on were pruned away; drop them from
     # the recorded feed list so inference callers need not supply them

@@ -38,6 +38,11 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
     helper = LayerHelper("prior_box")
     boxes = helper.create_tmp_variable("float32")
     variances = helper.create_tmp_variable("float32")
+    # priors are coordinate constants derived from the feature-map SHAPE
+    # (reference prior_box_op registers no grad); stating that here keeps
+    # the silent-zero-grad check quiet about the feature-map input
+    boxes.stop_gradient = True
+    variances.stop_gradient = True
     steps = steps or [0.0, 0.0]
     helper.append_op(
         type="prior_box", inputs={"Input": [input], "Image": [image]},
@@ -95,6 +100,12 @@ def target_assign(input, matched_indices, negative_indices=None,
     helper = LayerHelper("target_assign")
     out = helper.create_tmp_variable(input.dtype)
     out_weight = helper.create_tmp_variable("float32")
+    # assigned targets are training CONSTANTS (the reference registers no
+    # grad for target_assign; loc/conf loss grads flow only through the
+    # predictions) — marking them stop_gradient states that intent so the
+    # silent-zero-grad check in append_backward doesn't flag them
+    out.stop_gradient = True
+    out_weight.stop_gradient = True
     inputs = {"X": [input], "MatchIndices": [matched_indices]}
     if negative_indices is not None:
         inputs["NegIndices"] = [negative_indices]

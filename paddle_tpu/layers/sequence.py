@@ -12,7 +12,7 @@ from ..layer_helper import LayerHelper
 from ..initializer import ConstantInitializer
 
 __all__ = [
-    "sequence_unfold", "sequence_fold",
+    "sequence_unfold", "sequence_mask", "sequence_fold",
     "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit", "lstm_unit",
     "sequence_conv", "sequence_pool", "sequence_first_step",
     "sequence_last_step", "sequence_softmax", "sequence_expand",
@@ -290,4 +290,15 @@ def sequence_fold(x, outer_like):
     helper.append_op(type="sequence_fold",
                      inputs={"X": [x], "OuterLike": [outer_like]},
                      outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def sequence_mask(x, name=None):
+    """[B, T] float mask of valid positions for a padded sequence var
+    (1 inside each sequence, 0 in padding). Reads the lengths channel the
+    feed path attaches to LoD feeds; full-length for dense feeds."""
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_tmp_variable("float32")
+    helper.append_op(type="sequence_mask", inputs={"X": [x]},
+                     outputs={"Y": [out]})
     return out

@@ -6,7 +6,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["Accuracy", "ChunkEvaluator", "EditDistance", "CompositeMetric"]
+__all__ = ["Accuracy", "ChunkEvaluator", "EditDistance", "CompositeMetric",
+           "DetectionMAP"]
 
 
 class MetricBase:
@@ -113,3 +114,61 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class DetectionMAP(MetricBase):
+    """Accumulative detection mAP across minibatches (reference
+    evaluator.py:254 DetectionMAP). The reference threads growing
+    pos-count/true-pos/false-pos state tensors through a stateful
+    detection_map op; state tensors grow per batch, which XLA's static
+    shapes reject, so the TPU-native evaluator accumulates the raw
+    detections/ground-truths host-side and computes the running mAP with
+    the same kernel the in-graph per-batch metric uses
+    (ops/detection_ops.py detection_map_np).
+
+    update(dets, det_counts, gts, gt_counts): padded [B,D,6]/[B,G,6]
+    batches + per-sample valid counts (the fetched form of the
+    detection_map op's inputs). eval() -> accumulative mAP over every
+    batch seen since reset().
+    """
+
+    def __init__(self, class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral", name=None):
+        super().__init__(name)
+        self.background_label = background_label
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._label_pos = {}
+        self._tp = {}
+        self._fp = {}
+        self._n_updates = 0
+
+    def update(self, dets, det_counts, gts, gt_counts):
+        # incremental per-class contribution merge: per-image (score, tp/fp)
+        # pairs are independent, so a running mAP over N batches costs O(N)
+        # instead of recomputing over the full history each eval()
+        from .ops.detection_ops import detection_tp_fp
+        lp, tp, fp = detection_tp_fp(
+            np.asarray(dets, np.float32), np.asarray(det_counts, np.int64),
+            np.asarray(gts, np.float32), np.asarray(gt_counts, np.int64),
+            self.overlap_threshold, self.evaluate_difficult)
+        for k, v in lp.items():
+            self._label_pos[k] = self._label_pos.get(k, 0) + v
+        for k, v in tp.items():
+            self._tp.setdefault(k, []).extend(v)
+        for k, v in fp.items():
+            self._fp.setdefault(k, []).extend(v)
+        self._n_updates += 1
+
+    def eval(self):
+        if not self._n_updates:
+            raise ValueError("DetectionMAP.eval() before any update()")
+        from .ops.detection_ops import map_from_tp_fp
+        return float(map_from_tp_fp(
+            self._label_pos, self._tp, self._fp, self.ap_version,
+            self.background_label))

@@ -258,6 +258,10 @@ def _concat_infer(op_, block):
         if v is None or v.shape is None:
             return
         shapes.append(list(v.shape))
+    if any(len(s) <= axis for s in shapes):
+        # rank not statically known for some input (e.g. a var produced by
+        # an op whose infer bailed); leave the shape to runtime
+        return
     out = list(shapes[0])
     if any(s[axis] is None or s[axis] < 0 for s in shapes):
         out[axis] = -1

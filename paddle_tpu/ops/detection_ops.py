@@ -486,11 +486,12 @@ def _np_iou(a, b):
     return inter / max(a1 + a2 - inter, _EPS)
 
 
-def detection_map_np(dets, det_counts, gts, gt_counts, overlap_threshold,
-                     evaluate_difficult, ap_type, background_label):
-    """Host mAP (faithful port of reference detection_map_op.h
-    CalcTrueAndFalsePositive + CalcMAP). dets [B, D, 6] rows
-    (label, score, box); gts [B, G, 6] rows (label, difficult, box)."""
+def detection_tp_fp(dets, det_counts, gts, gt_counts, overlap_threshold,
+                    evaluate_difficult):
+    """Per-class positives + (score, tp/fp) contributions of a batch
+    (reference detection_map_op.h CalcTrueAndFalsePositive). Contributions
+    are independent across images, so callers (the accumulative evaluator)
+    can merge dicts across batches incrementally."""
     label_pos = {}
     tp, fp = {}, {}
     bsz = dets.shape[0]
@@ -537,6 +538,12 @@ def detection_map_np(dets, det_counts, gts, gt_counts, overlap_threshold,
                 else:
                     tp.setdefault(lab, []).append((score, 0))
                     fp.setdefault(lab, []).append((score, 1))
+    return label_pos, tp, fp
+
+
+def map_from_tp_fp(label_pos, tp, fp, ap_type, background_label):
+    """mAP from accumulated per-class contributions (reference
+    detection_map_op.h CalcMAP)."""
     mAP, count = 0.0, 0
     for lab, num_pos in label_pos.items():
         if lab == background_label or lab not in tp or num_pos == 0:
@@ -562,6 +569,17 @@ def detection_map_np(dets, det_counts, gts, gt_counts, overlap_threshold,
         mAP += ap
         count += 1
     return np.float32(mAP / count if count else 0.0)
+
+
+def detection_map_np(dets, det_counts, gts, gt_counts, overlap_threshold,
+                     evaluate_difficult, ap_type, background_label):
+    """Host mAP over one batch (faithful port of reference
+    detection_map_op.h). dets [B, D, 6] rows (label, score, box);
+    gts [B, G, 6] rows (label, difficult, box)."""
+    label_pos, tp, fp = detection_tp_fp(dets, det_counts, gts, gt_counts,
+                                        overlap_threshold,
+                                        evaluate_difficult)
+    return map_from_tp_fp(label_pos, tp, fp, ap_type, background_label)
 
 
 def _dmap_infer(op_, block):

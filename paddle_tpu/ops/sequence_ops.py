@@ -681,3 +681,22 @@ def _sequence_fold(ctx, op_, ins):
             inner = il
     ctx.set_seq_len2(out_name, inner)
     return {"Out": [out]}
+
+
+@op("sequence_mask", grad=NO_GRAD)
+def _sequence_mask(ctx, op_, ins):
+    """Dense [B, T] validity mask from a padded sequence var's lengths
+    channel (the padded-LoD equivalent of reading the LoD offset table,
+    reference lod_tensor.h:55; the mask is what sequence_softmax/rnn
+    lowerings use internally — this op exposes it to user programs, e.g.
+    attention over encoder states in a beam-search decoder)."""
+    x = jnp.asarray(ins["X"][0])
+    name = op_.desc.inputs["X"][0]
+    t = x.shape[1]
+    lengths = ctx.seq_len(name)
+    if lengths is None:
+        mask = jnp.ones(x.shape[:2], jnp.float32)
+    else:
+        steps = jnp.arange(t)[None, :]
+        mask = (steps < jnp.asarray(lengths)[:, None]).astype(jnp.float32)
+    return {"Y": [mask]}

@@ -94,6 +94,7 @@ class Optimizer:
                                   startup_program=None):
         program = loss.block.program
         global_block = program.global_block()
+        n_before = len(global_block.ops)
         self.helper = LayerHelper(self.__class__.__name__)
         self._create_global_learning_rate()
         self._create_accumulators(global_block,
@@ -107,6 +108,12 @@ class Optimizer:
                 optimize_ops.append(
                     self._append_optimize_op(global_block, param_and_grad))
         self._finish_update(global_block)
+        # role tag (reference OpRole::kOptimize): everything this pass
+        # appended — update ops, lr-schedule ops, accumulator bumps — is
+        # stripped by inference slicing, so a parameter's in-place ParamOut
+        # can never drag the training tail into a pruned inference program
+        for op in global_block.ops[n_before:]:
+            op.desc.attrs.setdefault("op_role", "optimize")
         return optimize_ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,

@@ -108,12 +108,15 @@ class PipelineTranspiler:
                 + sorted(param_names_all)
             fwd = program.prune(feeds=feeds, fetches=[out_name])
             fblock = fwd.global_block()
-            stage_params = sorted(
-                {n for op in fblock.ops for n in op.input_arg_names
-                 if n in trainable})
-            stage_feeds = sorted(
-                {n for op in fblock.ops for n in op.input_arg_names
-                 if n in data_names})
+            # op_external_reads recurses into sub-blocks: a param or feed
+            # read only inside a DynamicRNN/While body must still belong
+            # to the stage, or it would silently never train/feed
+            from ..framework.framework import op_external_reads
+            stage_reads = set()
+            for op in fblock.ops:
+                stage_reads |= op_external_reads(fwd, op)
+            stage_params = sorted(stage_reads & trainable)
+            stage_feeds = sorted(stage_reads & set(data_names))
 
             # gradient program: stage forward + IR-level vjp
             grad = fwd.clone()
@@ -191,10 +194,16 @@ class PipelineTranspiler:
     @staticmethod
     def _feed_var_names(program) -> List[str]:
         """Data vars = root-block vars nobody produces and that are not
-        parameters/persistable (the feed surface)."""
+        parameters/persistable (the feed surface). Reads are collected
+        recursively through sub-blocks (op_external_reads) so a feed
+        consumed only inside control flow still counts."""
+        from ..framework.framework import op_external_reads
         block = program.global_block()
         produced = {n for op in block.ops for n in op.output_arg_names}
         params = {p.name for p in block.all_parameters()}
+        reads = set()
+        for op in block.ops:
+            reads |= op_external_reads(program, op)
         names = []
         for name in block.desc.vars:
             v = block.var(name)
@@ -202,7 +211,7 @@ class PipelineTranspiler:
                 continue
             if getattr(v.desc, "persistable", False):
                 continue
-            if any(name in op.input_arg_names for op in block.ops):
+            if name in reads:
                 names.append(name)
         return names
 
@@ -227,9 +236,18 @@ class PipelineTrainer:
             exe.run(s.update_startup, scope=scope)
 
     def _split_feed(self, feed: Dict[str, np.ndarray]):
+        from ..executor import LoDTensor
         m = self.m
         micro = [dict() for _ in range(m)]
         for name, val in feed.items():
+            if isinstance(val, LoDTensor):
+                # a LoD feed's packed rows cannot be row-sliced into
+                # microbatches without splitting sequences mid-way; reject
+                # loudly instead of silently corrupting boundaries
+                raise ValueError(
+                    f"Pipeline microbatching does not support LoDTensor "
+                    f"feeds yet ('{name}'): pre-pad sequence feeds to "
+                    f"dense [batch, time, ...] arrays")
             val = np.asarray(val)
             assert val.shape[0] % m == 0, (
                 f"batch {val.shape[0]} not divisible into {m} microbatches")

@@ -1,0 +1,40 @@
+"""Shared book-chapter acceptance epilogue (reference
+tests/book/test_fit_a_line.py:139-146 + inference/tests/book/): after
+training, every chapter must (1) compute predictions from the live scope
+through the pruned test-mode program, (2) save_inference_model, (3) reload
+into a FRESH scope and re-run, (4) get identical predictions — proving the
+saved artifact reproduces the trained network, not merely that it loads."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+
+
+def assert_infer_roundtrip(exe, place, feed_dict, targets,
+                           main_program=None, rtol=1e-4, atol=1e-6):
+    """Returns the reloaded model's outputs after asserting they match the
+    live-scope predictions on the same feed."""
+    targets = targets if isinstance(targets, list) else [targets]
+    infer_prog = fluid.io.get_inference_program(targets, main_program)
+    expected = exe.run(infer_prog, feed=dict(feed_dict), fetch_list=targets)
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model")
+        fluid.io.save_inference_model(path, list(feed_dict), targets, exe,
+                                      main_program=main_program)
+        scope = executor_mod.Scope()
+        with executor_mod.scope_guard(scope):
+            infer_exe = fluid.Executor(place)
+            prog, feed_names, fetch_targets = \
+                fluid.io.load_inference_model(path, infer_exe)
+            got = infer_exe.run(
+                prog, feed={n: feed_dict[n] for n in feed_names},
+                fetch_list=fetch_targets)
+    for e, g in zip(expected, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                   rtol=rtol, atol=atol)
+    return got

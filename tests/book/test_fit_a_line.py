@@ -38,17 +38,8 @@ def test_fit_a_line_book():
             break
     assert last is not None and last < 1.0, f"loss did not drop: {last}"
 
-    with tempfile.TemporaryDirectory() as d:
-        path = os.path.join(d, "fit_a_line.model")
-        fluid.io.save_inference_model(path, ["x"], [y_predict], exe)
-
-        scope = executor_mod.Scope()
-        with executor_mod.scope_guard(scope):
-            infer_exe = fluid.Executor(place)
-            prog, feed_names, fetch_targets = \
-                fluid.io.load_inference_model(path, infer_exe)
-            xs = np.random.RandomState(0).randn(8, 13).astype(np.float32)
-            results, = infer_exe.run(prog, feed={feed_names[0]: xs},
-                                     fetch_list=fetch_targets)
-        assert results.shape == (8, 1)
-        assert np.isfinite(results).all()
+    from tests.book._roundtrip import assert_infer_roundtrip
+    xs = np.random.RandomState(0).randn(8, 13).astype(np.float32)
+    results, = assert_infer_roundtrip(exe, place, {"x": xs}, [y_predict])
+    assert np.asarray(results).shape == (8, 1)
+    assert np.isfinite(results).all()

@@ -18,9 +18,15 @@ def test_image_classification(net):
         model_fn, img, label, class_dim=10)
     fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
 
+    # vgg16 costs ~6x the residual net per step on the 1-core CI box; it
+    # gets a smaller batch + shorter run with a relative-improvement
+    # assert, while resnet carries the chapter's explicit-threshold
+    # convergence gate (the reference CI had the same split: GPU jobs
+    # trained to threshold, CPU jobs smoke-trained)
+    bsz, max_steps = (16, 15) if net == "vgg" else (32, 30)
     train_reader = fluid.batch(
         fluid.reader.shuffle(fluid.dataset.cifar.train10(), buf_size=512),
-        batch_size=32)
+        batch_size=bsz)
     place = fluid.TPUPlace()
     exe = fluid.Executor(place)
     feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
@@ -32,6 +38,19 @@ def test_image_classification(net):
         loss, a = exe.run(fluid.default_main_program(),
                           feed=feeder.feed(data), fetch_list=[avg_cost, acc])
         losses.append(float(np.ravel(loss)[0]))
-        if i >= 30:
+        if i >= max_steps:
             break
-    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+    if net == "resnet":
+        # explicit threshold: below the ln(10)=2.303 uniform-guess floor —
+        # the class-blob surrogate is separable, so learning must show
+        assert np.mean(losses[-5:]) < 2.2, losses
+    else:
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+    from tests.book._roundtrip import assert_infer_roundtrip
+    xs = np.random.RandomState(0).rand(4, 3, 32, 32).astype(np.float32)
+    probs, = assert_infer_roundtrip(exe, place, {"img": xs}, [predict],
+                                    rtol=1e-3, atol=1e-5)
+    probs = np.asarray(probs)
+    assert probs.shape == (4, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(4), rtol=1e-3)

@@ -76,7 +76,7 @@ def test_label_semantic_roles():
 
     rng = np.random.RandomState(0)
     losses = []
-    for step in range(80):
+    for step in range(55):
         batch = []
         for _ in range(16):
             fields = sample(rng)
@@ -96,3 +96,25 @@ def test_label_semantic_roles():
                     feed=feeder.feed(batch), fetch_list=[decode])
     assert np.issubdtype(path.dtype, np.integer)
     assert (path >= 0).all() and (path < LABEL_N).all()
+
+    # inference round-trip on the Viterbi decode path (the reference's C++
+    # inference test loads exactly this artifact)
+    from tests.book._roundtrip import assert_infer_roundtrip
+    from paddle_tpu.executor import LoDTensor
+
+    def lod_feed(batch_fields):
+        feed = {}
+        for name, col in zip(names, range(8)):
+            rows, offs = [], [0]
+            for b in batch_fields:
+                arr = np.asarray(b[col], np.int64)
+                rows.append(arr)
+                offs.append(offs[-1] + len(arr))
+            feed[name] = LoDTensor(np.concatenate(rows, 0), [offs])
+        return feed
+    fields4 = [tuple([[int(v)] for v in f] for f in sample(rng))
+               for _ in range(4)]
+    rt_path, = assert_infer_roundtrip(exe, place, lod_feed(fields4),
+                                      [decode])
+    rt_path = np.asarray(rt_path)
+    assert (rt_path >= 0).all() and (rt_path < LABEL_N).all()

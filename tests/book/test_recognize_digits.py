@@ -30,13 +30,25 @@ def test_recognize_digits(net):
     feeder = fluid.DataFeeder(place=place, feed_list=[img, label])
     exe.run(fluid.default_startup_program())
 
-    accs = []
+    accs, losses = [], []
     for i, data in enumerate(train_reader()):
         if net == "conv":
             data = [(np.reshape(im, (1, 28, 28)), l) for im, l in data]
         loss, a = exe.run(fluid.default_main_program(),
                           feed=feeder.feed(data), fetch_list=[avg_cost, acc])
         accs.append(float(np.ravel(a)[0]))
+        losses.append(float(np.ravel(loss)[0]))
         if i >= 60:
             break
+    # explicit thresholds (reference trains until avg_cost < 0.2-ish on a
+    # per-pass test set; the synthetic blobs converge much faster)
     assert np.mean(accs[-10:]) > 0.7, accs[-10:]
+    assert np.mean(losses[-10:]) < 1.0, losses[-10:]
+
+    from tests.book._roundtrip import assert_infer_roundtrip
+    shape = (4, 784) if net == "mlp" else (4, 1, 28, 28)
+    xs = np.random.RandomState(0).rand(*shape).astype(np.float32)
+    probs, = assert_infer_roundtrip(exe, place, {"img": xs}, [predict])
+    probs = np.asarray(probs)
+    assert probs.shape == (4, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(4), rtol=1e-4)

@@ -60,4 +60,22 @@ def test_recommender_system():
         losses.append(float(np.ravel(loss)[0]))
         if i >= 40:
             break
+    # explicit threshold: below the score variance (~1.2 on the synthetic
+    # ratings), i.e. the model predicts better than the mean rating
     assert losses[-1] < losses[0], (losses[0], losses[-1])
+    assert np.mean(losses[-5:]) < 2.5, losses[-5:]
+
+    from tests.book._roundtrip import assert_infer_roundtrip
+    from paddle_tpu.executor import LoDTensor
+    rng = np.random.RandomState(0)
+    feed = {"user_id": rng.randint(0, 100, (3, 1)).astype(np.int64),
+            "gender_id": rng.randint(0, 2, (3, 1)).astype(np.int64),
+            "age_id": rng.randint(0, 7, (3, 1)).astype(np.int64),
+            "job_id": rng.randint(0, 10, (3, 1)).astype(np.int64),
+            "movie_id": rng.randint(0, 100, (3, 1)).astype(np.int64),
+            "category_id": LoDTensor(
+                rng.randint(0, 18, (5, 1)).astype(np.int64), [[0, 2, 4, 5]]),
+            "movie_title": LoDTensor(
+                rng.randint(0, 5175, (7, 1)).astype(np.int64), [[0, 3, 5, 7]])}
+    out, = assert_infer_roundtrip(exe, place, feed, [predict])
+    assert np.asarray(out).shape == (3, 1)

@@ -70,3 +70,12 @@ def test_understand_sentiment(net):
         if i >= 30:
             break
     assert np.mean(accs[-5:]) > 0.8, accs
+
+    from tests.book._roundtrip import assert_infer_roundtrip
+    from paddle_tpu.executor import LoDTensor
+    rng = np.random.RandomState(0)
+    rows = [rng.randint(0, dict_dim, (n, 1)).astype(np.int64)
+            for n in (5, 3)]
+    feed = {"words": LoDTensor(np.concatenate(rows, 0), [[0, 5, 8]])}
+    out, = assert_infer_roundtrip(exe, place, feed, [logits])
+    assert np.asarray(out).shape == (2, 2)

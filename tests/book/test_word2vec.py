@@ -47,3 +47,10 @@ def test_word2vec():
                             feed=feeder.feed(data), fetch_list=[avg_cost])
             losses.append(float(np.ravel(loss)[0]))
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+    from tests.book._roundtrip import assert_infer_roundtrip
+    rng = np.random.RandomState(0)
+    ctx = {n: rng.randint(0, dict_size, (6, 1)).astype(np.int64)
+           for n in ("firstw", "secondw", "thirdw", "forthw")}
+    out, = assert_infer_roundtrip(exe, place, ctx, [logits])
+    assert np.asarray(out).shape == (6, dict_size)

@@ -72,3 +72,105 @@ class TestCAPI:
         assert len(preds) == 2
         np.testing.assert_allclose(preds, np.asarray(want).reshape(-1),
                                    rtol=1e-4, atol=1e-5)
+
+
+def _build_generic():
+    _build()
+    r = subprocess.run(
+        ["gcc", os.path.join(REPO, "examples/capi/infer_generic.c"),
+         "-I", NATIVE, "-L", NATIVE, "-lpaddle_tpu_capi", "-lm",
+         "-o", os.path.join(NATIVE, "infer_generic")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def _run_generic(model_dir, input_name, dims):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["LD_LIBRARY_PATH"] = NATIVE + os.pathsep + \
+        env.get("LD_LIBRARY_PATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([os.path.join(NATIVE, "infer_generic"),
+                        str(model_dir), input_name] +
+                       [str(d) for d in dims],
+                       capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr[-1500:])
+    return np.array([float(m) for m in
+                     re.findall(r"out\[\d+\]=([-\d.]+)", r.stdout)])
+
+
+def _c_pattern(shape):
+    n = int(np.prod(shape))
+    return np.sin(0.01 * np.arange(n)).astype(np.float32).reshape(shape)
+
+
+class TestCAPIConvModel:
+    def test_conv_model_through_c(self, tmp_path):
+        """A convolutional book model served through the C API (reference
+        inference/tests/book/test_inference_recognize_digits.cc)."""
+        _build_generic()
+        from paddle_tpu import models
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                    dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            avg_cost, predict, acc = models.build_image_classifier(
+                models.mnist_conv, img, label, class_dim=10)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(startup)
+            for _ in range(3):
+                xs = rng.rand(16, 1, 28, 28).astype(np.float32)
+                ys = rng.randint(0, 10, (16, 1)).astype(np.int64)
+                exe.run(main, feed={"img": xs, "label": ys},
+                        fetch_list=[avg_cost])
+            fluid.io.save_inference_model(str(tmp_path), ["img"], [predict],
+                                          exe, main_program=main)
+            cx = _c_pattern((2, 1, 28, 28))
+            prog, feeds, fetches = fluid.io.load_inference_model(
+                str(tmp_path), exe)
+            want, = exe.run(prog, feed={"img": cx}, fetch_list=fetches)
+        got = _run_generic(tmp_path, "img", (2, 1, 28, 28))
+        np.testing.assert_allclose(got, np.asarray(want).reshape(-1),
+                                   rtol=1e-3, atol=1e-5)
+
+
+class TestCAPISequenceModel:
+    def test_lstm_model_through_c(self, tmp_path):
+        """A sequence (LSTM) model served through the C API: dense float
+        sequence features [B,T,F] -> lstm -> last step -> fc."""
+        _build_generic()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            seq = fluid.layers.data(name="seq", shape=[-1, -1, 8],
+                                    dtype="float32",
+                                    append_batch_size=False)
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            proj = fluid.layers.fc(input=seq, size=64, num_flatten_dims=2)
+            h, _c = fluid.layers.dynamic_lstm(input=proj, size=64)
+            last = fluid.layers.sequence_last_step(h)
+            pred = fluid.layers.fc(input=last, size=1)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(startup)
+            for _ in range(3):
+                xs = rng.randn(8, 6, 8).astype(np.float32)
+                ys = xs.mean(axis=(1, 2), keepdims=False)[:, None]
+                exe.run(main, feed={"seq": xs, "y": ys.astype(np.float32)},
+                        fetch_list=[loss])
+            fluid.io.save_inference_model(str(tmp_path), ["seq"], [pred],
+                                          exe, main_program=main)
+            cx = _c_pattern((2, 6, 8))
+            prog, feeds, fetches = fluid.io.load_inference_model(
+                str(tmp_path), exe)
+            want, = exe.run(prog, feed={"seq": cx}, fetch_list=fetches)
+        got = _run_generic(tmp_path, "seq", (2, 6, 8))
+        np.testing.assert_allclose(got, np.asarray(want).reshape(-1),
+                                   rtol=1e-3, atol=1e-5)

@@ -233,3 +233,72 @@ class TestReorderLoDTensorByRank:
         want = [rows[1], rows[2], rows[0]]
         for i, w in enumerate(want):
             np.testing.assert_allclose(arr[lod[i]:lod[i + 1]], w, rtol=1e-6)
+
+
+class TestWeightedAverage:
+    def test_running_average(self):
+        from paddle_tpu.average import WeightedAverage
+        wa = WeightedAverage()
+        wa.add(2.0, 1)
+        wa.add(4.0, 3)
+        assert abs(wa.eval() - (2 + 12) / 4) < 1e-9
+        wa.reset()
+        import pytest
+        with pytest.raises(ValueError):
+            wa.eval()
+        with pytest.raises(ValueError):
+            wa.add("x", 1)
+
+
+class TestDetectionMAPEvaluator:
+    def test_accumulates_across_batches(self):
+        import numpy as np
+        from paddle_tpu.metrics import DetectionMAP
+        ev = DetectionMAP(overlap_threshold=0.5, ap_version="integral")
+        # batch 1: one perfect detection of the single gt
+        det1 = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4]]], np.float32)
+        gt1 = np.array([[[1, 0, 0.1, 0.1, 0.4, 0.4]]], np.float32)
+        ev.update(det1, [1], gt1, [1])
+        m1 = ev.eval()
+        assert m1 > 0.99, m1
+        # batch 2: a miss (wrong place) for a second gt lowers the mAP
+        det2 = np.array([[[1, 0.8, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+        gt2 = np.array([[[1, 0, 0.1, 0.1, 0.4, 0.4]]], np.float32)
+        ev.update(det2, [1], gt2, [1])
+        m2 = ev.eval()
+        assert m2 < m1, (m1, m2)
+        ev.reset()
+        import pytest
+        with pytest.raises(ValueError):
+            ev.eval()
+
+
+class TestNewDatasets:
+    def test_flowers_schema(self):
+        import numpy as np
+        from paddle_tpu.dataset import flowers
+        it = flowers.train()()
+        img, label = next(it)
+        assert img.shape == (3 * 224 * 224,) and img.dtype == np.float32
+        assert 0 <= label < flowers.CLASS_NUM
+
+    def test_voc2012_schema(self):
+        import numpy as np
+        from paddle_tpu.dataset import voc2012
+        img, mask = next(voc2012.train()())
+        assert img.shape[0] == 3 and img.ndim == 3
+        assert mask.shape == img.shape[1:]
+        assert mask.max() < voc2012.CLASS_NUM
+
+    def test_wmt16_schema(self):
+        from paddle_tpu.dataset import wmt16
+        src, trg, nxt = next(wmt16.train(100, 120)())
+        assert trg[0] == 0 and nxt[-1] == 1
+        assert len(trg) == len(nxt)
+        assert wmt16.get_dict("en", 50)["<e>"] == 1
+
+    def test_sentiment_schema(self):
+        from paddle_tpu.dataset import sentiment
+        words, label = next(sentiment.train()())
+        assert label in (0, 1)
+        assert all(isinstance(w, int) for w in words)
