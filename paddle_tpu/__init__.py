@@ -44,6 +44,8 @@ from . import amp
 from . import compat
 from . import metrics
 from . import average
+from . import errors
+from . import flags
 from .parallel import transpiler
 from .parallel.transpiler import DistributeTranspiler
 

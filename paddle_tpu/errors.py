@@ -1,0 +1,33 @@
+"""Typed error hierarchy (reference: paddle/fluid/platform/enforce.h
+EnforceNotMet + pybind/exception.cc mapping C++ exceptions onto Python
+types). The executor raises EnforceNotMet for op execution failures — it
+carries the failing operator, its declared inputs/outputs, the live input
+shapes, and the op's Python creation site (CustomStackTrace parity,
+reference paddle/utils/CustomStackTrace.h layer-stack dump)."""
+
+from __future__ import annotations
+
+__all__ = ["EnforceNotMet", "EOFException", "NotFoundError"]
+
+
+class EnforceNotMet(RuntimeError):
+    """An operator's runtime contract failed (reference PADDLE_ENFORCE)."""
+
+    def __init__(self, message, op_type=None, creation_site=None):
+        super().__init__(message)
+        self.op_type = op_type
+        self.creation_site = creation_site
+
+
+class NotFoundError(KeyError):
+    """A variable/operator lookup failed (reference NotFound error code)."""
+
+
+def __getattr__(name):
+    # canonical home of EOFException is layers.io (it predates this
+    # module); lazily re-exported so the typed hierarchy is one import
+    # away without an import cycle
+    if name == "EOFException":
+        from .layers.io import EOFException
+        return EOFException
+    raise AttributeError(name)

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import contextlib
 import os
+import sys
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -270,6 +272,17 @@ class LoweringContext:
 
 _EAGER = os.environ.get("PADDLE_TPU_EAGER", "0") == "1"
 _CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
+_BENCHMARK = os.environ.get("PADDLE_TPU_BENCHMARK", "0") == "1"
+_VLOG_LEVEL = int(os.environ.get("PADDLE_TPU_VLOG", "0") or 0)
+
+
+def vlog(level: int, msg: str):
+    """glog-style leveled logging (reference VLOG; enable with
+    PADDLE_TPU_VLOG=<level>)."""
+    if level <= _VLOG_LEVEL:
+        import datetime
+        ts = datetime.datetime.now().strftime("%H:%M:%S.%f")[:-3]
+        print(f"V{level} {ts} paddle_tpu] {msg}", file=sys.stderr)
 
 # FP-exception trapping (reference TrainerMain.cpp:49 feenableexcept
 # FE_INVALID|FE_DIVBYZERO|FE_OVERFLOW): the XLA-world equivalent is
@@ -683,19 +696,33 @@ class Executor:
             ins = {slot: [v.to_dense() if isinstance(v, SelectedRowsVal)
                           else v for v in vals]
                    for slot, vals in ins.items()}
+        t0 = time.perf_counter() if _BENCHMARK and _EAGER else None
         try:
             outs = opdef.lower(ctx, op, ins)
         except (AssertionError, TypeError, ValueError, IndexError) as e:
-            # PADDLE_ENFORCE-style context (reference platform/enforce.h):
-            # name the failing operator and its variables, with the live
-            # input shapes, instead of a bare JAX traceback
+            # PADDLE_ENFORCE-style context (reference platform/enforce.h +
+            # utils/CustomStackTrace.h layer-stack dump): name the failing
+            # operator, its variables, the live input shapes, and the user
+            # line that built the op, instead of a bare JAX traceback
+            from .errors import EnforceNotMet
             shapes = {slot: [getattr(v, "shape", None) for v in vals]
                       for slot, vals in ins.items()}
-            raise RuntimeError(
+            site = getattr(op, "creation_site", None)
+            raise EnforceNotMet(
                 f"Operator {op.type} failed: {e}\n"
                 f"  inputs: {dict(op.desc.inputs)}\n"
                 f"  input shapes: {shapes}\n"
-                f"  outputs: {dict(op.desc.outputs)}") from e
+                f"  outputs: {dict(op.desc.outputs)}\n"
+                f"  built at: {site or '<unknown>'}",
+                op_type=op.type, creation_site=site) from e
+        if t0 is not None:
+            # FLAGS_benchmark parity (reference executor.cc:321): wait for
+            # device completion per op and log wall time
+            jax.block_until_ready(jax.tree.leaves(
+                {k: [v for v in vs if v is not None]
+                 for k, vs in outs.items()}))
+            vlog(1, f"[benchmark] {op.type}: "
+                    f"{(time.perf_counter() - t0) * 1e3:.3f} ms")
         # Default SEQLEN propagation mirrors the reference's LoD propagation
         # (most ops share LoD with their first sequence input); sequence
         # lowerings override via ctx.set_seq_len. Inheritance is restricted

@@ -1,0 +1,79 @@
+"""Central flag registry (reference: paddle/utils/Flags.cpp's 28 gflags +
+the fluid DEFINE_* flags scattered near use, forwarded via
+core.init_gflags). Flags are declared here with defaults/help, read from
+`PADDLE_TPU_<NAME>` environment variables (the TPU-native analogue of
+gflags' --name=value), and queryable at runtime:
+
+    from paddle_tpu import flags
+    flags.get("check_nan_inf")      # -> bool
+    flags.dump()                    # -> {name: (value, help)}
+
+Modules keep reading their flags at import time for zero overhead; this
+registry is the single catalogue of what exists (reference Flags.cpp role).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Tuple
+
+_REGISTRY: Dict[str, Tuple[Any, type, str]] = {}
+
+
+def define(name: str, default, help_str: str, type_=None):
+    t = type_ or type(default)
+    _REGISTRY[name] = (default, t, help_str)
+    return get(name)
+
+
+def _parse(raw: str, t: type, default):
+    # match exactly how the modules read their env vars: bool flags are on
+    # only for "1" (executor.py etc. test == "1"), numeric flags tolerate
+    # an empty value by falling back to the default
+    if t is bool:
+        return raw == "1"
+    if raw == "":
+        return default
+    return t(raw)
+
+
+def get(name: str):
+    default, t, _ = _REGISTRY[name]
+    raw = os.environ.get(f"PADDLE_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    return _parse(raw, t, default)
+
+
+def dump() -> Dict[str, Tuple[Any, str]]:
+    return {n: (get(n), h) for n, (_, _, h) in sorted(_REGISTRY.items())}
+
+
+# --- the catalogue (reference Flags.cpp / executor.cc DEFINE_bool etc.) ----
+define("eager", False,
+       "op-by-op interpretation instead of whole-block jit "
+       "(reference executor.cc interpreter semantics; debugging)")
+define("check_nan_inf", False,
+       "scan op outputs for NaN/Inf each step "
+       "(reference FLAGS_check_nan_inf, executor.cc:325)")
+define("trap_fp", False,
+       "raise at the op producing NaN/Inf via jax debug-nans "
+       "(reference TrainerMain.cpp:49 feenableexcept)")
+define("benchmark", False,
+       "eager mode: wait for device completion after every op and log "
+       "per-op wall time (reference FLAGS_benchmark, executor.cc:321)")
+define("allow_zero_grad", False,
+       "permit NO_GRAD ops with differentiable inputs on the loss path "
+       "instead of raising (append_backward safety check)")
+define("vlog", 0,
+       "verbose logging level; >0 enables paddle_tpu.vlog output "
+       "(reference glog VLOG levels)")
+define("record_ops", "",
+       "file path: append every executed op type (tools/op_coverage.py)")
+define("test_platform", "cpu",
+       "jax platform the test suite forces (tests/conftest.py)")
+define("xla_cache", "",
+       "persistent XLA compilation cache dir override (tests/conftest.py)")
+define("max_loop_iters", 128,
+       "default while-loop step-scope recording capacity "
+       "(While(max_iters=...) overrides per loop)")

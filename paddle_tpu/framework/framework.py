@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import os
+import sys
 from typing import Any, Dict, Iterable, List, Optional
 
 import numpy as np
@@ -69,6 +71,24 @@ def op_external_reads(program, op) -> set:
                 elif isinstance(a, BlocksRef):
                     sub_idxs.extend(a.idxs)
     return reads
+
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _user_frame() -> Optional[str]:
+    """file:line of the first stack frame outside paddle_tpu (cheap: walks
+    frames, no traceback objects)."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:
+        return None
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR) or os.sep + "tests" + os.sep in fn:
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return None
 
 
 def grad_var_name(name: str) -> str:
@@ -198,6 +218,11 @@ class Operator:
     def __init__(self, block: "Block", desc: OpDesc):
         self.block = block
         self.desc = desc
+        # Python creation site (first frame outside paddle_tpu): the
+        # CustomStackTrace analogue (reference utils/CustomStackTrace.h
+        # dumps the layer stack on crash) — executor error messages point
+        # at the user line that built the failing op.
+        self.creation_site = _user_frame()
 
     @property
     def type(self) -> str:

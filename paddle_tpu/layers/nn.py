@@ -32,6 +32,8 @@ __all__ = [
     "prelu", "crop", "spp", "unpool", "conv3d_transpose",
     "max_pool2d_with_index", "conv_shift", "l1_norm",
     "fused_attention", "sparse_moe",
+
+    "hsigmoid", "bilinear_interp", "selective_fc",
 ]
 
 
@@ -1097,3 +1099,57 @@ def sparse_moe(x, num_experts, hidden_size, capacity_factor=1.25,
                      outputs={"Out": [out]},
                      attrs={"capacity_factor": capacity_factor})
     return out
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid classification cost over a complete binary tree
+    (reference gserver HierarchicalSigmoidLayer.cpp; fluid hsigmoid). Cost
+    is -log P(label) under the tree factorization; O(log C) tree nodes per
+    sample instead of a C-way softmax. Returns [B, 1]."""
+    helper = LayerHelper("hierarchical_sigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[1, num_classes - 1],
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    cost = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="hierarchical_sigmoid", inputs=inputs,
+                     outputs={"Cost": [cost]},
+                     attrs={"num_classes": int(num_classes)})
+    return cost
+
+
+def bilinear_interp(input, out_h, out_w, name=None):
+    """Bilinear upsampling of NCHW feature maps (reference gserver
+    BilinearInterpLayer.cpp; corners-aligned ratio (in-1)/(out-1))."""
+    helper = LayerHelper("bilinear_interp", name=name)
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="bilinear_interp", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"out_h": int(out_h), "out_w": int(out_w)})
+    return out
+
+
+def selective_fc(input, select, size, act=None, param_attr=None,
+                 bias_attr=None, name=None):
+    """Fully-connected layer computing only selected output columns per
+    sample (reference gserver SelectiveFullyConnectedLayer.cpp: with a
+    selection the layer evaluates just those columns; the TPU-native dense
+    form computes the full gemm on the MXU and masks — identical outputs,
+    zeros at unselected columns, and XLA fuses the mask into the gemm
+    epilogue). `select` is a [B, size] 0/1 mask."""
+    out = fc(input=input, size=size, act=act, param_attr=param_attr,
+             bias_attr=bias_attr, name=name)
+    helper = LayerHelper("selective_fc", name=name)
+    masked = helper.create_tmp_variable(out.dtype)
+    helper.append_op(type="elementwise_mul",
+                     inputs={"X": [out], "Y": [select]},
+                     outputs={"Out": [masked]}, attrs={"axis": -1})
+    return masked

@@ -141,7 +141,11 @@ def _sr_unflatten(names, children):
 
 jax.tree_util.register_pytree_node(ScopeRecordVal, _sr_flatten, _sr_unflatten)
 
-DEFAULT_MAX_LOOP_ITERS = 128
+# default while-loop step-scope recording capacity; per-loop override via
+# While(max_iters=...), global override via PADDLE_TPU_MAX_LOOP_ITERS
+import os as _os
+DEFAULT_MAX_LOOP_ITERS = int(
+    _os.environ.get("PADDLE_TPU_MAX_LOOP_ITERS") or 128)
 
 
 def _block_writes(program, block_idx) -> List[str]:

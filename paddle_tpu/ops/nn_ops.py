@@ -739,3 +739,42 @@ def _moe_ffn(ctx, op_, ins):
     if restore is not None:
         out = out.astype(restore)
     return {"Out": [out]}
+
+
+def _hsigmoid_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None and xv.shape is not None:
+        set_out(op_, block, "Cost", [xv.shape[0], 1], xv.dtype)
+
+
+@op("hierarchical_sigmoid", infer_shape=_hsigmoid_infer,
+    non_diff_inputs=("Label",))
+def _hierarchical_sigmoid(ctx, op_, ins):
+    """Hierarchical sigmoid over a complete binary code tree (reference
+    gserver HierarchicalSigmoidLayer.cpp: codeLength = 1 + floor(log2(
+    numClasses - 1)); per-class code bits walk the tree). Cost per sample =
+    sum_j softplus(pre_j) - bit_j * pre_j over the label's path, which is
+    -log P(label) under the tree factorization. Vectorized over a fixed
+    max code length with a validity mask — no per-sample loops, MXU gemm
+    for all path nodes at once."""
+    x = jnp.asarray(ins["X"][0])                       # [B, F]
+    w = jnp.asarray(ins["W"][0])                       # [C-1, F]
+    label = jnp.asarray(ins["Label"][0]).reshape(-1)   # [B]
+    bias = ins.get("Bias", [None])[0]
+    num_classes = int(op_.attr("num_classes"))
+    code_len = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+
+    c = (label + num_classes).astype(jnp.int32)        # SimpleCode basis
+    js = jnp.arange(code_len)
+    shifted = c[:, None] >> (js[None, :] + 1)          # [B, J]
+    valid = (shifted >= 1).astype(x.dtype)
+    idx = jnp.maximum(shifted - 1, 0)                  # node ids [B, J]
+    bits = ((c[:, None] >> js[None, :]) & 1).astype(x.dtype)
+
+    wn = w[idx]                                        # [B, J, F]
+    pre = jnp.einsum("bf,bjf->bj", x, wn)
+    if bias is not None:
+        b = jnp.asarray(bias).reshape(-1)              # [C-1]
+        pre = pre + b[idx]
+    cost = (jax.nn.softplus(pre) - bits * pre) * valid
+    return {"Cost": [jnp.sum(cost, axis=1, keepdims=True)]}

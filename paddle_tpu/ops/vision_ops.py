@@ -454,3 +454,50 @@ def _conv_shift(ctx, op_, ins):
     for j in range(n):
         out = out + jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]
     return {"Out": [out]}
+
+
+def _bilinear_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None and xv.shape is not None:
+        set_out(op_, block, "Out",
+                [xv.shape[0], xv.shape[1], op_.attr("out_h"),
+                 op_.attr("out_w")], xv.dtype)
+
+
+@op("bilinear_interp", infer_shape=_bilinear_infer)
+def _bilinear_interp(ctx, op_, ins):
+    """Bilinear upsampling NCHW (reference gserver BilinearInterpLayer.cpp /
+    hl_cnn.h bilinear ops: ratio = (in-1)/(out-1), i.e. corners aligned).
+    Pure gather + lerp so the vjp (downsampling grad) is a scatter XLA
+    fuses with surrounding work."""
+    x = jnp.asarray(ins["X"][0])                       # [B, C, H, W]
+    out_h = int(op_.attr("out_h"))
+    out_w = int(op_.attr("out_w"))
+    b, ch, h, w = x.shape
+
+    def grid(in_size, out_size):
+        # grid math in f32 regardless of x's dtype: a bf16 arange already
+        # misindexes past 256, duplicating/skipping source rows
+        if out_size == 1 or in_size == 1:
+            return (jnp.zeros((out_size,), jnp.float32),
+                    jnp.zeros((out_size,), jnp.int32),
+                    jnp.zeros((out_size,), jnp.int32))
+        ratio = (in_size - 1.0) / (out_size - 1.0)
+        pos = jnp.arange(out_size, dtype=jnp.float32) * ratio
+        lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, in_size - 1)
+        hi = jnp.minimum(lo + 1, in_size - 1)
+        return pos - lo.astype(jnp.float32), lo, hi
+
+    fh, h0, h1 = grid(h, out_h)
+    fw, w0, w1 = grid(w, out_w)
+    xh0 = x[:, :, h0]
+    xh1 = x[:, :, h1]
+    tl = xh0[:, :, :, w0]
+    tr = xh0[:, :, :, w1]
+    bl = xh1[:, :, :, w0]
+    br = xh1[:, :, :, w1]
+    fh = fh[None, None, :, None].astype(x.dtype)
+    fw = fw[None, None, None, :].astype(x.dtype)
+    top = tl * (1 - fw) + tr * fw
+    bot = bl * (1 - fw) + br * fw
+    return {"Out": [top * (1 - fh) + bot * fh]}

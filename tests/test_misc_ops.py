@@ -302,3 +302,57 @@ class TestNewDatasets:
         words, label = next(sentiment.train()())
         assert label in (0, 1)
         assert all(isinstance(w, int) for w in words)
+
+
+class TestUtilsParity:
+    def test_flag_registry(self):
+        from paddle_tpu import flags
+        d = flags.dump()
+        assert "check_nan_inf" in d and "benchmark" in d
+        assert flags.get("max_loop_iters") == 128
+        import os
+        os.environ["PADDLE_TPU_VLOG"] = "3"
+        try:
+            assert flags.get("vlog") == 3
+        finally:
+            del os.environ["PADDLE_TPU_VLOG"]
+
+    def test_enforce_not_met_carries_context(self):
+        import pytest
+        import paddle_tpu as fluid
+        from paddle_tpu.errors import EnforceNotMet
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[5], dtype="float32")
+        bad = fluid.layers.elementwise_add(x, y)   # shape mismatch at run
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        with pytest.raises(EnforceNotMet) as ei:
+            exe.run(feed={"x": np.ones((2, 4), np.float32),
+                          "y": np.ones((2, 5), np.float32)},
+                    fetch_list=[bad], use_jit=False)
+        assert ei.value.op_type == "elementwise_add"
+        # creation site points at THIS test file, not framework internals
+        assert ei.value.creation_site and \
+            "test_misc_ops.py" in ei.value.creation_site
+
+    def test_benchmark_sync_mode_logs(self):
+        import subprocess, sys as _sys, os as _os
+        repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+        script = (
+            "import numpy as np\n"
+            "import paddle_tpu as fluid\n"
+            "x = fluid.layers.data(name='x', shape=[4], dtype='float32')\n"
+            "y = fluid.layers.scale(x, scale=2.0)\n"
+            "exe = fluid.Executor(fluid.CPUPlace())\n"
+            "exe.run(fluid.default_startup_program())\n"
+            "r, = exe.run(feed={'x': np.ones((2, 4), np.float32)},"
+            " fetch_list=[y], use_jit=False)\n"
+            "print('ok', float(r.sum()))\n")
+        env = dict(_os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu",
+                   PADDLE_TPU_EAGER="1", PADDLE_TPU_BENCHMARK="1",
+                   PADDLE_TPU_VLOG="1")
+        r = subprocess.run([_sys.executable, "-c", script],
+                           capture_output=True, text=True, env=env,
+                           timeout=300)
+        assert r.returncode == 0, r.stderr[-800:]
+        assert "[benchmark] scale" in r.stderr, r.stderr[-800:]

@@ -272,3 +272,127 @@ def test_dropout_train_eval():
     frac_zero = (tr == 0).mean()
     assert 0.15 < frac_zero < 0.45
     assert set(np.unique(tr)) <= {0.0, 1.0}
+
+
+class TestHsigmoid:
+    def test_cost_matches_manual_and_trains(self):
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod
+        num_classes = 10
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32",
+                              append_batch_size=False, stop_gradient=False)
+        label = fluid.layers.data(name="hl", shape=[1], dtype="int64",
+                                  append_batch_size=False)
+        cost = fluid.layers.hsigmoid(
+            x, label, num_classes,
+            param_attr=fluid.ParamAttr(name="hs_w"),
+            bias_attr=fluid.ParamAttr(name="hs_b"))
+        loss = fluid.layers.mean(cost)
+        fluid.backward.append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = executor_mod.Scope()
+        rng = np.random.RandomState(0)
+        with executor_mod.scope_guard(sc):
+            exe.run(fluid.default_startup_program())
+            w = (rng.randn(num_classes - 1, 8) * 0.3).astype(np.float32)
+            b = (rng.randn(1, num_classes - 1) * 0.1).astype(np.float32)
+            sc.set_var("hs_w", w)
+            sc.set_var("hs_b", b)
+            xv = rng.randn(4, 8).astype(np.float32)
+            lv = np.array([[3], [0], [9], [5]], np.int64)
+            block = fluid.default_main_program().global_block()
+            cv, gx = exe.run(fluid.default_main_program(),
+                             feed={"x": xv, "hl": lv},
+                             fetch_list=[cost, block.var("x@GRAD")])
+        # manual reference: walk the SimpleCode tree per sample
+        def manual(xr, lab):
+            c = int(lab) + num_classes
+            total, j = 0.0, 0
+            while (c >> (j + 1)) >= 1:
+                idx = (c >> (j + 1)) - 1
+                bit = (c >> j) & 1
+                pre = float(xr @ w[idx] + b[0, idx])
+                total += np.logaddexp(0.0, pre) - bit * pre
+                j += 1
+            return total
+        want = [manual(xv[i], lv[i, 0]) for i in range(4)]
+        np.testing.assert_allclose(np.ravel(cv), want, rtol=1e-5)
+        assert np.abs(gx).sum() > 0      # differentiable
+
+    def test_probabilities_normalize(self):
+        """sum_c P(c) = 1 under the tree factorization: exp(-cost) summed
+        over all labels must be ~1 for any x."""
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod
+        num_classes = 8
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                              append_batch_size=False)
+        label = fluid.layers.data(name="hl", shape=[1], dtype="int64",
+                                  append_batch_size=False)
+        cost = fluid.layers.hsigmoid(x, label, num_classes,
+                                     bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = executor_mod.Scope()
+        rng = np.random.RandomState(1)
+        with executor_mod.scope_guard(sc):
+            exe.run(fluid.default_startup_program())
+            xv = np.repeat(rng.randn(1, 4).astype(np.float32),
+                           num_classes, axis=0)
+            lv = np.arange(num_classes, dtype=np.int64)[:, None]
+            cv, = exe.run(fluid.default_main_program(),
+                          feed={"x": xv, "hl": lv}, fetch_list=[cost])
+        probs = np.exp(-np.ravel(cv))
+        np.testing.assert_allclose(probs.sum(), 1.0, rtol=1e-4)
+
+
+class TestBilinearInterp:
+    def test_matches_manual_align_corners(self):
+        import paddle_tpu as fluid
+        x = fluid.layers.data(name="x", shape=[1, 2, 2], dtype="float32")
+        up = fluid.layers.bilinear_interp(x, out_h=3, out_w=3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        xv = np.array([[[[0.0, 1.0], [2.0, 3.0]]]], np.float32)
+        r, = exe.run(feed={"x": xv}, fetch_list=[up])
+        want = np.array([[0.0, 0.5, 1.0], [1.0, 1.5, 2.0],
+                         [2.0, 2.5, 3.0]], np.float32)
+        np.testing.assert_allclose(r[0, 0], want, rtol=1e-6)
+
+    def test_gradient_flows(self):
+        import paddle_tpu as fluid
+        x = fluid.layers.data(name="x", shape=[1, 2, 2], dtype="float32",
+                              stop_gradient=False)
+        up = fluid.layers.bilinear_interp(x, out_h=4, out_w=4)
+        loss = fluid.layers.reduce_sum(up)
+        fluid.backward.append_backward(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        block = fluid.default_main_program().global_block()
+        g, = exe.run(feed={"x": np.ones((1, 1, 2, 2), np.float32)},
+                     fetch_list=[block.var("x@GRAD")])
+        # conservation: sum of grads equals number of output elements
+        np.testing.assert_allclose(g.sum(), 16.0, rtol=1e-5)
+
+
+class TestSelectiveFC:
+    def test_masked_columns_zero_and_match_fc(self):
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        sel = fluid.layers.data(name="sel", shape=[6], dtype="float32")
+        out = fluid.layers.selective_fc(
+            x, sel, size=6, param_attr=fluid.ParamAttr(name="sfc_w"),
+            bias_attr=fluid.ParamAttr(name="sfc_b"))
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = executor_mod.Scope()
+        rng = np.random.RandomState(0)
+        with executor_mod.scope_guard(sc):
+            exe.run(fluid.default_startup_program())
+            w = rng.randn(4, 6).astype(np.float32)
+            b = rng.randn(6).astype(np.float32)
+            sc.set_var("sfc_w", w)
+            sc.set_var("sfc_b", b)
+            xv = rng.randn(3, 4).astype(np.float32)
+            sv = (rng.rand(3, 6) < 0.5).astype(np.float32)
+            r, = exe.run(feed={"x": xv, "sel": sv}, fetch_list=[out])
+        np.testing.assert_allclose(r, (xv @ w + b) * sv, rtol=1e-5)
