@@ -45,6 +45,7 @@ from . import compat
 from . import metrics
 from . import average
 from . import errors
+from . import v2
 from . import flags
 from .parallel import transpiler
 from .parallel.transpiler import DistributeTranspiler

@@ -1,0 +1,16 @@
+"""v2-style user API facade (reference: python/paddle/v2 — layer.py/
+topology.py graph building, trainer.py:37 SGD event loop, parameters.py
+numpy get/set + tar serialization, event.py callbacks, inference.py).
+
+The reference v2 stack compiled its own ModelConfig proto and drove the
+legacy C++ GradientMachine through SWIG; here the same USER SURFACE builds
+fluid Programs underneath — one stack, two API skins, exactly how the
+reference's book examples moved from v2 to fluid without retraining users.
+"""
+
+from . import activation, data_type, event, layer, optimizer, parameters
+from .inference import infer
+from .trainer import SGD
+
+__all__ = ["activation", "data_type", "event", "layer", "optimizer",
+           "parameters", "infer", "SGD"]

@@ -1,0 +1,26 @@
+"""v2 activation objects (reference: python/paddle/trainer_config_helpers/
+activations.py): Relu()/Tanh()/... map onto the fluid act strings."""
+
+
+class _Act:
+    name = None
+
+
+class Linear(_Act):
+    name = None
+
+
+class Relu(_Act):
+    name = "relu"
+
+
+class Tanh(_Act):
+    name = "tanh"
+
+
+class Sigmoid(_Act):
+    name = "sigmoid"
+
+
+class Softmax(_Act):
+    name = "softmax"

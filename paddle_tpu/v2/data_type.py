@@ -1,0 +1,26 @@
+"""v2 input type declarations (reference: python/paddle/trainer/
+PyDataProvider2.py dense_vector :?, integer_value :226,
+integer_value_sequence :236; re-exported as paddle.v2.data_type)."""
+
+
+class InputType:
+    def __init__(self, dim, seq=0, is_int=False):
+        self.dim = dim
+        self.seq = seq          # 0 = no sequence, 1 = sequence
+        self.is_int = is_int
+
+
+def dense_vector(dim):
+    return InputType(dim)
+
+
+def dense_vector_sequence(dim):
+    return InputType(dim, seq=1)
+
+
+def integer_value(value_range):
+    return InputType(value_range, is_int=True)
+
+
+def integer_value_sequence(value_range):
+    return InputType(value_range, seq=1, is_int=True)

@@ -1,0 +1,33 @@
+"""v2 inference (reference: python/paddle/v2/inference.py infer())."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import DataFeeder, Executor, TPUPlace, io as fluid_io
+from .. import executor as executor_mod
+from ..framework.framework import default_startup_program
+
+
+def infer(output_layer, parameters, input, feeding=None):
+    """Run the topology up to output_layer with the given parameters over
+    per-sample input tuples (reference inference.py:infer)."""
+    program = output_layer.block.program
+    infer_prog = fluid_io.get_inference_program([output_layer], program)
+    block = infer_prog.global_block()
+    exe = Executor(TPUPlace(0))
+    scope = parameters._scope
+    if scope is None:
+        scope = executor_mod.Scope()
+        parameters._scope = scope
+        with executor_mod.scope_guard(scope):
+            exe.run(default_startup_program())
+    feeding = feeding or {}
+    order = sorted(feeding, key=feeding.get)
+    feed_vars = [block.var(n) for n in order]
+    batch = [tuple(sample[feeding[n]] for n in order) for sample in input]
+    feeder = DataFeeder(place=exe.place, feed_list=feed_vars)
+    with executor_mod.scope_guard(scope):
+        out, = exe.run(infer_prog, feed=feeder.feed(batch),
+                       fetch_list=[output_layer.name])
+    return np.asarray(out)

@@ -1,0 +1,88 @@
+"""v2 layer DSL (reference: python/paddle/v2/layer.py + trainer_config_
+helpers/layers.py wrappers). Each call builds fluid IR in the default
+program; the returned Variables ARE the v2 "Layer" handles (the reference
+wrapped config-proto nodes; here the IR is the config)."""
+
+from __future__ import annotations
+
+from .. import layers as fluid_layers
+from .activation import _Act
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, _Act) or isinstance(act, type) and issubclass(act, _Act):
+        return act.name
+    return act
+
+
+def data(name, type):
+    """Input declaration (reference v2/layer data); type is a
+    data_type.InputType."""
+    if type.is_int:
+        return fluid_layers.data(name=name, shape=[1], dtype="int64",
+                                 lod_level=type.seq)
+    return fluid_layers.data(name=name, shape=[type.dim], dtype="float32",
+                             lod_level=type.seq)
+
+
+def fc(input, size, act=None, **kw):
+    return fluid_layers.fc(input=input, size=size, act=_act_name(act))
+
+
+def embedding(input, size, **kw):
+    """size = embedding dim (reference embedding_layer); the vocab extent
+    comes from the data layer's integer_value range."""
+    vocab = kw.pop("vocab_size", None)
+    if vocab is None:
+        vocab = kw.pop("input_range", None)
+    if vocab is None:
+        raise ValueError("embedding needs vocab_size= (the reference reads "
+                         "it from the data layer's integer_value range)")
+    return fluid_layers.embedding(input=input, size=[vocab, size])
+
+
+def simple_lstm(input, size, **kw):
+    """fc projection + LSTM (reference trainer_config_helpers simple_lstm =
+    mixed+lstmemory); returns the hidden sequence."""
+    proj = fluid_layers.fc(input=input, size=size * 4, num_flatten_dims=2)
+    h, _c = fluid_layers.dynamic_lstm(input=proj, size=size * 4)
+    return h
+
+
+def last_seq(input):
+    return fluid_layers.sequence_last_step(input)
+
+
+def first_seq(input):
+    return fluid_layers.sequence_first_step(input)
+
+
+def max_pooling(input):
+    return fluid_layers.sequence_pool(input, "max")
+
+
+def sum_pooling(input):
+    return fluid_layers.sequence_pool(input, "sum")
+
+
+def concat(input):
+    return fluid_layers.concat(input, axis=1)
+
+
+def square_error_cost(input, label):
+    return fluid_layers.mean(
+        fluid_layers.square_error_cost(input=input, label=label))
+
+
+def classification_cost(input, label):
+    """softmax + cross entropy on logits-or-probs: the v2 layer applied
+    softmax itself, so `input` here is the pre-softmax fc output."""
+    return fluid_layers.mean(fluid_layers.softmax_with_cross_entropy(
+        logits=input, label=label))
+
+
+def cross_entropy_cost(input, label):
+    return fluid_layers.mean(
+        fluid_layers.cross_entropy(input=input, label=label))

@@ -1,0 +1,31 @@
+"""v2 optimizers (reference: python/paddle/v2/optimizer.py) delegating to
+the fluid optimizer classes."""
+
+from .. import optimizer as fluid_opt
+
+
+class Optimizer:
+    def __init__(self, fluid_optimizer):
+        self.fluid_optimizer = fluid_optimizer
+
+
+def Momentum(momentum=0.9, learning_rate=1e-3, **kw):
+    return Optimizer(fluid_opt.Momentum(learning_rate=learning_rate,
+                                        momentum=momentum))
+
+
+def Adam(learning_rate=1e-3, beta1=0.9, beta2=0.999, **kw):
+    return Optimizer(fluid_opt.Adam(learning_rate=learning_rate,
+                                    beta1=beta1, beta2=beta2))
+
+
+def SGD(learning_rate=1e-3, **kw):
+    return Optimizer(fluid_opt.SGD(learning_rate=learning_rate))
+
+
+def AdaGrad(learning_rate=1e-3, **kw):
+    return Optimizer(fluid_opt.Adagrad(learning_rate=learning_rate))
+
+
+def RMSProp(learning_rate=1e-3, **kw):
+    return Optimizer(fluid_opt.RMSProp(learning_rate=learning_rate))

@@ -1,0 +1,59 @@
+"""v2 Parameters: numpy get/set + tar serialization (reference:
+python/paddle/v2/parameters.py — __getitem__/__setitem__ over the
+GradientMachine's buffers, to_tar/from_tar per-param files)."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+
+class Parameters:
+    def __init__(self, program):
+        self._program = program
+        self._scope = None      # bound by trainer.SGD / inference.infer
+
+    # --- topology ----------------------------------------------------------
+    def names(self):
+        return sorted(p.name for p in
+                      self._program.global_block().all_parameters())
+
+    def _bound(self):
+        if self._scope is None:
+            raise RuntimeError("Parameters not bound to a trainer yet "
+                               "(create a v2.SGD or call infer first)")
+        return self._scope
+
+    def __getitem__(self, name):
+        return np.asarray(self._bound().find_var(name))
+
+    def __setitem__(self, name, value):
+        self._bound().set_var(name, np.asarray(value, np.float32))
+
+    def keys(self):
+        return self.names()
+
+    # --- serialization (reference to_tar/from_tar) -------------------------
+    def to_tar(self, f):
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self.names():
+                buf = io.BytesIO()
+                np.save(buf, self[name])
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+    def from_tar(self, f):
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                arr = np.load(io.BytesIO(tar.extractfile(member).read()))
+                self[member.name] = arr
+
+
+def create(cost):
+    """Parameters of the topology that produces `cost` (reference
+    parameters.create)."""
+    return Parameters(cost.block.program)

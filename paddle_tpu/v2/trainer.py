@@ -1,0 +1,70 @@
+"""v2 SGD trainer event loop (reference: python/paddle/v2/trainer.py:37
+SGD, :137 train — reader + topology + update_equation, event_handler
+callbacks per iteration/pass)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import DataFeeder, Executor, TPUPlace
+from .. import executor as executor_mod
+from ..framework.framework import default_startup_program
+from . import event as v2_event
+from .optimizer import Optimizer
+from .parameters import Parameters
+
+
+class SGD:
+    """cost + parameters + update_equation -> .train(reader, ...)
+    (reference trainer.py SGD; the name is historical — any v2 optimizer
+    is accepted)."""
+
+    def __init__(self, cost, parameters, update_equation, extra_layers=None,
+                 is_local=True, **kw):
+        if not isinstance(parameters, Parameters):
+            raise TypeError("parameters should be parameters")
+        if not isinstance(update_equation, Optimizer):
+            raise TypeError("update equation parameter must be "
+                            "paddle_tpu.v2.optimizer.Optimizer")
+        self.__cost__ = cost
+        self.__parameters__ = parameters
+        self.__program__ = cost.block.program
+        update_equation.fluid_optimizer.minimize(cost)
+        self.__scope__ = executor_mod.Scope()
+        parameters._scope = self.__scope__
+        self.__exe__ = Executor(TPUPlace(0))
+        with executor_mod.scope_guard(self.__scope__):
+            self.__exe__.run(default_startup_program())
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        """reader yields per-sample tuples; feeding maps data-layer name ->
+        tuple position (reference trainer.py:137)."""
+        event_handler = event_handler or (lambda e: None)
+        block = self.__program__.global_block()
+        feed_names = list(feeding) if feeding else None
+        with executor_mod.scope_guard(self.__scope__):
+            for pass_id in range(num_passes):
+                event_handler(v2_event.BeginPass(pass_id))
+                for batch_id, batch in enumerate(reader()):
+                    if feeding:
+                        order = sorted(feeding, key=feeding.get)
+                        batch = [tuple(sample[feeding[n]] for n in order)
+                                 for sample in batch]
+                        feed_vars = [block.var(n) for n in order]
+                    else:
+                        feed_vars = None
+                    if feed_vars is None:
+                        raise ValueError(
+                            "v2 SGD.train needs feeding={name: position}")
+                    event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                    feeder = DataFeeder(place=self.__exe__.place,
+                                        feed_list=feed_vars)
+                    cost_v, = self.__exe__.run(
+                        self.__program__, feed=feeder.feed(batch),
+                        fetch_list=[self.__cost__])
+                    event_handler(v2_event.EndIteration(
+                        pass_id, batch_id, float(np.ravel(cost_v)[0])))
+                event_handler(v2_event.EndPass(pass_id))
+
+    def save_parameter_to_tar(self, f):
+        self.__parameters__.to_tar(f)

@@ -1,0 +1,97 @@
+"""v2-style API facade (reference: python/paddle/v2 — layer DSL, SGD
+event-loop trainer, Parameters numpy/tar access, infer): the reference's
+pre-fluid user surface must work end-to-end over the fluid stack."""
+
+import io
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import v2 as paddle
+
+
+def test_v2_fit_a_line_event_loop():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(13))
+    y = paddle.layer.fc(input=x, size=1)
+    label = paddle.layer.data(name="y",
+                              type=paddle.data_type.dense_vector(1))
+    cost = paddle.layer.square_error_cost(input=y, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(momentum=0.9, learning_rate=1e-2)
+    trainer = paddle.SGD(cost=cost, parameters=parameters,
+                         update_equation=optimizer)
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(13, 1).astype(np.float32)
+
+    def reader():
+        r = np.random.RandomState(1)
+        for _ in range(8):
+            batch = []
+            for _ in range(32):
+                xs = r.randn(13).astype(np.float32)
+                batch.append((xs, (xs @ w).astype(np.float32)))
+            yield batch
+
+    events = {"iters": 0, "passes": 0, "costs": []}
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            events["iters"] += 1
+            events["costs"].append(e.cost)
+        elif isinstance(e, paddle.event.EndPass):
+            events["passes"] += 1
+
+    trainer.train(reader, num_passes=3, event_handler=handler,
+                  feeding={"x": 0, "y": 1})
+    assert events["passes"] == 3 and events["iters"] == 24
+    assert events["costs"][-1] < events["costs"][0] * 0.5, events["costs"]
+
+    # parameters: numpy access + tar round-trip
+    names = parameters.names()
+    assert names, names
+    buf = io.BytesIO()
+    parameters.to_tar(buf)
+    snap = {n: parameters[n].copy() for n in names}
+    parameters[names[0]] = np.zeros_like(snap[names[0]])
+    buf.seek(0)
+    parameters.from_tar(buf)
+    np.testing.assert_allclose(parameters[names[0]], snap[names[0]])
+
+    # inference over the trained parameters
+    out = paddle.infer(output_layer=y, parameters=parameters,
+                       input=[(np.ones(13, np.float32),)],
+                       feeding={"x": 0})
+    assert out.shape == (1, 1) and np.isfinite(out).all()
+
+
+def test_v2_classification_with_embedding():
+    V = 40
+    word = paddle.layer.data(name="w",
+                             type=paddle.data_type.integer_value(V))
+    emb = paddle.layer.embedding(input=word, size=16, vocab_size=V)
+    hidden = paddle.layer.fc(input=emb, size=32,
+                             act=paddle.activation.Tanh())
+    logits = paddle.layer.fc(input=hidden, size=2)
+    label = paddle.layer.data(name="l",
+                              type=paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(input=logits, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.SGD(cost=cost, parameters=parameters,
+                         update_equation=paddle.optimizer.Adam(
+                             learning_rate=5e-3))
+
+    def reader():
+        r = np.random.RandomState(0)
+        for _ in range(20):
+            ws = r.randint(0, V, 32)
+            yield [([int(w)], [int(w % 2)]) for w in ws]
+
+    costs = []
+    trainer.train(reader, num_passes=2,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None,
+                  feeding={"w": 0, "l": 1})
+    assert costs[-1] < costs[0] * 0.6, (costs[0], costs[-1])
