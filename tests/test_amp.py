@@ -112,6 +112,6 @@ def test_amp_bf16_in_compiled_hlo():
             {"img": jnp.zeros((8, 3, 16, 16), jnp.float32),
              "label": jnp.zeros((8, 1), jnp.int32)},
             {n: jnp.asarray(scope.find_var(n)) for n in cb.state_names},
-            jax.random.key(0)).as_text())
+            np.uint32(0)).as_text())
     import re
     assert re.search(r"convolution.*bf16", txt), "no bf16 convolutions"
