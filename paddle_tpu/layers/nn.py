@@ -1048,20 +1048,22 @@ def l1_norm(x, name=None):
 
 
 def fused_attention(q, k, v, causal=False,
-                    sequence_parallel=False, name=None):
+                    sequence_parallel=False, use_flash=False, name=None):
     """Fused attention over [B, T, H, D] tensors; sequence_parallel=True
     runs ring attention over the program mesh's 'sp' axis
-    (parallel/ring_attention.py) for long-context training. (Named
-    fused_attention because reference-parity
-    nets.scaled_dot_product_attention already takes [B, T, D] with
-    num_heads and different semantics.)"""
+    (parallel/ring_attention.py) for long-context training; use_flash=True
+    runs the Pallas online-softmax VMEM kernel (ops/pallas_attention.py) —
+    O(T) memory, scores never hit HBM. (Named fused_attention because
+    reference-parity nets.scaled_dot_product_attention already takes
+    [B, T, D] with num_heads and different semantics.)"""
     helper = LayerHelper("fused_attention")
     out = helper.create_tmp_variable(q.dtype)
     helper.append_op(type="scaled_dot_product_attention",
                      inputs={"Q": [q], "K": [k], "V": [v]},
                      outputs={"Out": [out]},
                      attrs={"causal": causal,
-                            "sequence_parallel": sequence_parallel})
+                            "sequence_parallel": sequence_parallel,
+                            "use_flash": use_flash})
     return out
 
 

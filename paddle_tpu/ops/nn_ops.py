@@ -681,6 +681,15 @@ def _scaled_dot_product_attention(ctx, op_, ins):
             "sp" in mesh.axis_names:
         out = ring_attention_sharded(q, k, v, mesh, axis="sp",
                                      causal=causal)
+    elif op_.attr("use_flash", False):
+        # Pallas flash attention (ops/pallas_attention.py): O(T) memory
+        # online-softmax VMEM kernel; falls back to the XLA reference for
+        # non-tileable shapes
+        from . import pallas_attention
+        if pallas_attention.supports(q, k, v):
+            out = pallas_attention.flash_attention(q, k, v, causal)
+        else:
+            out = attention_reference(q, k, v, causal=causal)
     else:
         out = attention_reference(q, k, v, causal=causal)
     if restore is not None:

@@ -1,0 +1,140 @@
+"""Flash attention as a Pallas TPU kernel (the "pallas for the hot ops"
+tier of the compute path; /opt/skills/guides/pallas_guide.md patterns).
+
+Forward: online-softmax blocks — Q tiles stay resident in VMEM while K/V
+tiles stream through, carrying the running max/denominator, so the [T, T]
+score matrix never materializes in HBM (memory O(T) instead of O(T^2),
+same contract as parallel/ring_attention.py across chips but within one
+core's VMEM).
+
+Backward: jax.custom_vjp recomputes through the reference attention —
+the standard recompute tradeoff; gradients are bitwise those of
+attention_reference, which the ring-attention tests already validate.
+
+On CPU (the test mesh) the kernel runs under the Pallas interpreter
+(interpret=True) — same code path, no Mosaic compile. Shapes must tile:
+T divisible by the block (128, or T itself when smaller); callers
+fall back to attention_reference otherwise (ops/nn_ops.py wiring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "supports"]
+
+_NEG = -1e30
+
+
+def supports(q, k, v) -> bool:
+    """Static-shape eligibility: [B, T, H, D] with T tileable."""
+    if q.ndim != 4 or q.shape != k.shape or q.shape != v.shape:
+        return False
+    t = q.shape[1]
+    return t >= 8 and (t <= 128 or t % 128 == 0)
+
+
+def _block(t: int) -> int:
+    return 128 if t % 128 == 0 else t
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block: int, t: int, scale: float,
+            causal: bool):
+    from jax import lax
+
+    qi = jax.lax.axis_index if False else None  # (pallas: use program_id)
+    import jax.experimental.pallas as pl
+
+    pid_q = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    bq = q.shape[0]
+    d = q.shape[1]
+
+    n_k = t // block
+    if causal:
+        # blocks strictly past the diagonal contribute nothing; with
+        # BQ == BK the diagonal block is index pid_q
+        n_live = pid_q + 1
+    else:
+        n_live = n_k
+
+    def body(i, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.dslice(i * block, block), :].astype(jnp.float32)
+        vb = v_ref[0, pl.dslice(i * block, block), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = pid_q * block + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block), 0)
+            kpos = i * block + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = lax.fori_loop(0, n_live, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _forward(q, k, v, causal):
+    import jax.experimental.pallas as pl
+
+    b, t, h, d = q.shape
+    block = _block(t)
+    scale = 1.0 / (d ** 0.5)
+    # [B, T, H, D] -> [B*H, T, D]: heads become independent grid rows
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    interpret = jax.default_backend() != "tpu"
+    grid = (b * h, t // block)
+    out = pl.pallas_call(
+        functools.partial(_kernel, block=block, t=t, scale=scale,
+                          causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q, k, v, causal=False):
+    """softmax(QK^T/sqrt(D) [+causal mask]) V over [B, T, H, D]."""
+    return _forward(q, k, v, causal)
+
+
+def _fwd(q, k, v, causal):
+    return _forward(q, k, v, causal), (q, k, v)
+
+
+def _bwd(causal, res, g):
+    from ..parallel.ring_attention import attention_reference
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: attention_reference(a, b, c,
+                                                         causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

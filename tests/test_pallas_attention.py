@@ -1,0 +1,92 @@
+"""Pallas flash-attention kernel (ops/pallas_attention.py): online-softmax
+VMEM kernel vs the XLA reference. On the CPU test platform the kernel runs
+under the Pallas interpreter — the same code Mosaic compiles on TPU
+(measured r3: 1.5x over the XLA reference at T=4096 causal on v5e)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.ops.pallas_attention import flash_attention, supports
+from paddle_tpu.parallel.ring_attention import attention_reference
+
+RNG = np.random.default_rng(7)
+
+
+def _qkv(b, t, h, d):
+    return tuple(jnp.asarray(RNG.standard_normal((b, t, h, d))
+                             .astype(np.float32)) for _ in range(3))
+
+
+class TestFlashKernel:
+    @pytest.mark.parametrize("shape", [(2, 64, 2, 32), (1, 128, 4, 64),
+                                       (2, 256, 2, 64)])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, shape, causal):
+        q, k, v = _qkv(*shape)
+        # ambient default matmul precision on this platform is bf16-class;
+        # compare the algorithms at full precision
+        with jax.default_matmul_precision("highest"):
+            got = flash_attention(q, k, v, causal)
+            want = attention_reference(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-5, atol=2e-6)
+
+    def test_gradients_match_reference(self):
+        q, k, v = _qkv(1, 128, 2, 32)
+        with jax.default_matmul_precision("highest"):
+            g1 = jax.grad(lambda a, b, c: jnp.sum(
+                flash_attention(a, b, c, True) ** 2), argnums=(0, 1, 2))(
+                    q, k, v)
+            g2 = jax.grad(lambda a, b, c: jnp.sum(
+                attention_reference(a, b, c, causal=True) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-6)
+
+    def test_supports_gating(self):
+        q, k, v = _qkv(1, 100, 2, 32)       # 100: not 128-tileable, >128? no
+        assert supports(*_qkv(1, 256, 1, 64))
+        assert supports(*_qkv(1, 64, 1, 64))
+        assert not supports(*_qkv(1, 257, 1, 64)[:3])
+        q3 = jnp.zeros((2, 64, 32))
+        assert not supports(q3, q3, q3)
+
+
+class TestFlashThroughProgram:
+    def test_layer_flash_matches_plain(self):
+        """fused_attention(use_flash=True) through the executor equals the
+        plain path on the same feed."""
+        from paddle_tpu import executor as executor_mod
+        outs = {}
+        qv = RNG.standard_normal((2, 64, 2, 32)).astype(np.float32)
+        kv = RNG.standard_normal((2, 64, 2, 32)).astype(np.float32)
+        vv = RNG.standard_normal((2, 64, 2, 32)).astype(np.float32)
+        for flash in (False, True):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                q = fluid.layers.data(name="q", shape=[-1, 64, 2, 32],
+                                      dtype="float32",
+                                      append_batch_size=False)
+                k = fluid.layers.data(name="k", shape=[-1, 64, 2, 32],
+                                      dtype="float32",
+                                      append_batch_size=False)
+                v = fluid.layers.data(name="v", shape=[-1, 64, 2, 32],
+                                      dtype="float32",
+                                      append_batch_size=False)
+                out = fluid.layers.fused_attention(q, k, v, causal=True,
+                                                   use_flash=flash)
+            exe = fluid.Executor(fluid.CPUPlace())
+            sc = executor_mod.Scope()
+            with executor_mod.scope_guard(sc):
+                exe.run(startup)
+                with jax.default_matmul_precision("highest"):
+                    r, = exe.run(main, feed={"q": qv, "k": kv, "v": vv},
+                                 fetch_list=[out])
+            outs[flash] = np.asarray(r)
+        np.testing.assert_allclose(outs[True], outs[False],
+                                   rtol=2e-5, atol=2e-6)
