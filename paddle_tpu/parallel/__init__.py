@@ -5,6 +5,7 @@ from . import mesh
 from .mesh import get_mesh, set_mesh, data_parallel_mesh
 from . import transpiler
 from . import multihost
+from . import master
 from . import tensor_parallel
 from .tensor_parallel import (shard_parameter, shard_fc_params,
                               shard_all_params_zero)
