@@ -19,7 +19,7 @@ from ..framework.desc import VarType
 from ..framework.framework import default_main_program, default_startup_program
 
 __all__ = ["data", "open_recordio_file", "read_file", "shuffle", "batch",
-           "double_buffer", "EOFException"]
+           "multi_pass", "double_buffer", "EOFException"]
 
 
 class EOFException(Exception):
@@ -178,6 +178,21 @@ def batch(reader, batch_size):
 
     reader.source = source
     reader.batched = True
+    return reader
+
+
+def multi_pass(reader, pass_num):
+    """Re-run the underlying source pass_num times before EOF (reference
+    create_multi_pass_reader_op.cc, test_multi_pass_reader.py): training
+    loops drain one reader for N epochs without resetting it."""
+    inner = reader.source
+
+    def source():
+        for _ in range(pass_num):
+            for s in inner():
+                yield s
+
+    reader.source = source
     return reader
 
 

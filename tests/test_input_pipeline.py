@@ -103,3 +103,24 @@ class TestRecordIOReaderPipeline:
             got, = exe.run(main, fetch_list=[pooled])
         want = np.stack([r.sum(0) for r in rows])
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+class TestMultiPass:
+    def test_multi_pass_repeats_stream(self, tmp_path):
+        import os
+        from paddle_tpu import recordio as recordio_mod
+        path = os.path.join(str(tmp_path), "mp.recordio")
+        recordio_mod.write_samples(
+            path, [(np.full((2,), i, np.float32),) for i in range(3)])
+        r = fluid.layers.open_recordio_file(
+            path, shapes=[[2]], lod_levels=[0], dtypes=["float32"])
+        r = fluid.layers.multi_pass(r, pass_num=2)
+        r = fluid.layers.batch(r, batch_size=1)
+        vals = []
+        try:
+            while True:
+                (b,) = r.next_batch()
+                vals.append(int(np.asarray(b).ravel()[0]))
+        except fluid.layers.EOFException:
+            pass
+        assert vals == [0, 1, 2, 0, 1, 2], vals
