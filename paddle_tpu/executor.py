@@ -511,7 +511,10 @@ class Executor:
                    tuple(sorted(feed_vals)), tuple(fetch_names),
                    tuple(state_keys), self.place,
                    getattr(program, "_amp_dtype", None),
-                   getattr(program, "_amp_level", "O1"))
+                   getattr(program, "_amp_level", "O1"),
+                   # the seed folds into the compiled step (see _compile),
+                   # so changing program.random_seed must recompile
+                   program.random_seed)
             compiled = self._cache.get(key) if use_program_cache else None
             if compiled is None:
                 compiled = self._compile(program, state_keys, sorted(feed_vals),

@@ -44,8 +44,6 @@ def _block(t: int) -> int:
 def _kernel(q_ref, k_ref, v_ref, o_ref, *, block: int, t: int, scale: float,
             causal: bool):
     from jax import lax
-
-    qi = jax.lax.axis_index if False else None  # (pallas: use program_id)
     import jax.experimental.pallas as pl
 
     pid_q = pl.program_id(1)

@@ -14,6 +14,14 @@ requeue to whoever asks next. That is the EDL data-plane contract
 of etcd; compute elasticity still means restart-from-checkpoint with a
 new mesh (README scope notes).
 
+CORRECTNESS REQUIREMENT: the queue directory's filesystem must honor
+flock ACROSS the participating hosts — true for a local disk shared by
+same-host processes and for NFSv4 (or NFSv3/Lustre mounted with flock
+enabled), NOT for NFSv3/Lustre default "localflock" mounts, where two
+hosts could both win the lock and lose mutations. Multi-host clusters on
+such mounts should put the queue on the job's coordinator host and export
+it properly, exactly where the reference put etcd.
+
 todo/pending(leased)/done/failed states mirror service.go's taskQueues
 {Todo, Pending, Done, Failed}.
 """
@@ -41,15 +49,16 @@ class TaskQueue:
         self._lock = os.path.join(dirname, "queue.lock")
 
     # --- locked snapshot mutation (service.go:207 snapshot per mutation) --
-    def _mutate(self, fn):
+    def _mutate(self, fn, readonly_ok: bool = False):
         with open(self._lock, "w") as lk:
             fcntl.flock(lk, fcntl.LOCK_EX)
             state = self._read()
+            expired = False
             if state is not None:
-                self._requeue_expired(state)
+                expired = self._requeue_expired(state)
             out = fn(state)
             state = out[0] if isinstance(out, tuple) else out
-            if state is not None:
+            if state is not None and not (readonly_ok and not expired):
                 tmp = self._snap + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(state, f)
@@ -62,14 +71,16 @@ class TaskQueue:
         with open(self._snap) as f:
             return json.load(f)
 
-    def _requeue_expired(self, state):
-        """Timeout requeue (service.go:341 checkTimeoutFunc)."""
+    def _requeue_expired(self, state) -> bool:
+        """Timeout requeue (service.go:341 checkTimeoutFunc); returns
+        whether anything changed."""
         now = self.clock()
         expired = [tid for tid, lease in state["pending"].items()
                    if lease["deadline"] <= now]
         for tid in expired:
             del state["pending"][tid]
             self._fail_task(state, tid)
+        return bool(expired)
 
     def _fail_task(self, state, tid):
         """Failure budget (service.go:313 processFailedTask)."""
@@ -130,10 +141,13 @@ class TaskQueue:
         self._mutate(fn)
 
     def pass_done(self) -> bool:
+        # read-mostly: expired-lease requeue is the only mutation that can
+        # matter here; skip the snapshot rewrite when nothing expired
+        # (idle workers poll this in the drain-wait loop)
         def fn(state):
             return state, (state is not None and not state["todo"]
                            and not state["pending"])
-        return self._mutate(fn)
+        return self._mutate(fn, readonly_ok=True)
 
     def reset_pass(self):
         """Start the next pass over the same tasks (the reference's
@@ -155,7 +169,7 @@ class TaskQueue:
                 return state, {}
             return state, {k: len(state[k])
                            for k in ("todo", "pending", "done", "failed")}
-        return self._mutate(fn)
+        return self._mutate(fn, readonly_ok=True)
 
 
 def elastic_reader(queue: TaskQueue, chunk_fetch: Callable[[Any], List],

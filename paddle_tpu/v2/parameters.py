@@ -13,7 +13,7 @@ import numpy as np
 class Parameters:
     def __init__(self, program):
         self._program = program
-        self._scope = None      # bound by trainer.SGD / inference.infer
+        self._scope = None      # shared with trainer.SGD / inference.infer
 
     # --- topology ----------------------------------------------------------
     def names(self):
@@ -21,9 +21,16 @@ class Parameters:
                       self._program.global_block().all_parameters())
 
     def _bound(self):
+        """Scope holding the parameter values; created lazily by running
+        the startup program (so the reference's save-in-one-process /
+        from_tar-then-infer-in-another flow works without a trainer)."""
         if self._scope is None:
-            raise RuntimeError("Parameters not bound to a trainer yet "
-                               "(create a v2.SGD or call infer first)")
+            from .. import Executor, TPUPlace
+            from .. import executor as executor_mod
+            from ..framework.framework import default_startup_program
+            self._scope = executor_mod.Scope()
+            with executor_mod.scope_guard(self._scope):
+                Executor(TPUPlace(0)).run(default_startup_program())
         return self._scope
 
     def __getitem__(self, name):

@@ -30,35 +30,41 @@ class SGD:
         self.__parameters__ = parameters
         self.__program__ = cost.block.program
         update_equation.fluid_optimizer.minimize(cost)
-        self.__scope__ = executor_mod.Scope()
-        parameters._scope = self.__scope__
         self.__exe__ = Executor(TPUPlace(0))
+        if parameters._scope is not None:
+            # parameters pre-bound (e.g. from_tar before training): keep
+            # their values across the startup run, which re-initializes
+            # every parameter (reference SGD keeps the Parameters buffers)
+            self.__scope__ = parameters._scope
+            preloaded = {n: parameters[n].copy()
+                         for n in parameters.names()
+                         if self.__scope__.find_var(n) is not None}
+        else:
+            self.__scope__ = executor_mod.Scope()
+            parameters._scope = self.__scope__
+            preloaded = {}
         with executor_mod.scope_guard(self.__scope__):
             self.__exe__.run(default_startup_program())
+        for n, val in preloaded.items():
+            parameters[n] = val
 
     def train(self, reader, num_passes=1, event_handler=None, feeding=None):
         """reader yields per-sample tuples; feeding maps data-layer name ->
         tuple position (reference trainer.py:137)."""
+        if not feeding:
+            raise ValueError("v2 SGD.train needs feeding={name: position}")
         event_handler = event_handler or (lambda e: None)
         block = self.__program__.global_block()
-        feed_names = list(feeding) if feeding else None
+        order = sorted(feeding, key=feeding.get)
+        feed_vars = [block.var(n) for n in order]
+        feeder = DataFeeder(place=self.__exe__.place, feed_list=feed_vars)
         with executor_mod.scope_guard(self.__scope__):
             for pass_id in range(num_passes):
                 event_handler(v2_event.BeginPass(pass_id))
                 for batch_id, batch in enumerate(reader()):
-                    if feeding:
-                        order = sorted(feeding, key=feeding.get)
-                        batch = [tuple(sample[feeding[n]] for n in order)
-                                 for sample in batch]
-                        feed_vars = [block.var(n) for n in order]
-                    else:
-                        feed_vars = None
-                    if feed_vars is None:
-                        raise ValueError(
-                            "v2 SGD.train needs feeding={name: position}")
+                    batch = [tuple(sample[feeding[n]] for n in order)
+                             for sample in batch]
                     event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                    feeder = DataFeeder(place=self.__exe__.place,
-                                        feed_list=feed_vars)
                     cost_v, = self.__exe__.run(
                         self.__program__, feed=feeder.feed(batch),
                         fetch_list=[self.__cost__])

@@ -49,12 +49,22 @@ class TestFlashKernel:
                                        rtol=2e-5, atol=2e-6)
 
     def test_supports_gating(self):
-        q, k, v = _qkv(1, 100, 2, 32)       # 100: not 128-tileable, >128? no
+        # T <= 128 takes the block = T path (works untiled); larger T must
+        # tile by 128; rank-3 inputs are rejected
+        assert supports(*_qkv(1, 100, 2, 32))
         assert supports(*_qkv(1, 256, 1, 64))
         assert supports(*_qkv(1, 64, 1, 64))
-        assert not supports(*_qkv(1, 257, 1, 64)[:3])
+        assert not supports(*_qkv(1, 257, 1, 64))
         q3 = jnp.zeros((2, 64, 32))
         assert not supports(q3, q3, q3)
+
+    def test_sub128_untiled_path_matches(self):
+        q, k, v = _qkv(1, 100, 1, 32)       # block = T = 100
+        with jax.default_matmul_precision("highest"):
+            got = flash_attention(q, k, v, True)
+            want = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
 
 
 class TestFlashThroughProgram:

@@ -95,3 +95,47 @@ def test_v2_classification_with_embedding():
                   if isinstance(e, paddle.event.EndIteration) else None,
                   feeding={"w": 0, "l": 1})
     assert costs[-1] < costs[0] * 0.6, (costs[0], costs[-1])
+
+
+def test_v2_from_tar_then_infer_fresh_process_flow():
+    """The save-then-load-elsewhere flow (reference parameters.from_tar +
+    inference.infer without a trainer): loading into freshly created
+    Parameters must drive inference with the LOADED weights."""
+    # build once, train briefly, snapshot to tar
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.fc(input=x, size=1)
+    label = paddle.layer.data(name="l",
+                              type=paddle.data_type.dense_vector(1))
+    cost = paddle.layer.square_error_cost(input=y, label=label)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.SGD(cost=cost, parameters=params,
+                         update_equation=paddle.optimizer.SGD(
+                             learning_rate=0.1))
+
+    def reader():
+        r = np.random.RandomState(0)
+        for _ in range(5):
+            yield [(r.randn(4).astype(np.float32),
+                    np.array([1.0], np.float32)) for _ in range(16)]
+
+    trainer.train(reader, num_passes=2, feeding={"x": 0, "l": 1})
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    probe = np.full((1, 4), 0.5, np.float32)
+    want = paddle.infer(output_layer=y, parameters=params,
+                        input=[(probe[0],)], feeding={"x": 0})
+
+    # "fresh process": new Parameters object, from_tar BEFORE any trainer
+    params2 = paddle.parameters.create(cost)
+    buf.seek(0)
+    params2.from_tar(buf)
+    got = paddle.infer(output_layer=y, parameters=params2,
+                       input=[(probe[0],)], feeding={"x": 0})
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    # and pre-loaded weights survive trainer creation
+    trainer2 = paddle.SGD(cost=cost, parameters=params2,
+                          update_equation=paddle.optimizer.SGD(
+                              learning_rate=0.1))
+    for n in params.names():
+        np.testing.assert_allclose(params2[n], params[n], rtol=1e-6)
