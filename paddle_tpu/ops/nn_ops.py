@@ -680,7 +680,8 @@ def _scaled_dot_product_attention(ctx, op_, ins):
     if op_.attr("sequence_parallel", False) and mesh is not None and \
             "sp" in mesh.axis_names:
         out = ring_attention_sharded(q, k, v, mesh, axis="sp",
-                                     causal=causal)
+                                     causal=causal,
+                                     use_flash=op_.attr("use_flash", False))
     elif op_.attr("use_flash", False):
         # Pallas flash attention (ops/pallas_attention.py): O(T) memory
         # online-softmax VMEM kernel; falls back to the XLA reference for

@@ -116,6 +116,113 @@ def _forward(q, k, v, causal):
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
+def _block_kernel(off_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
+                  block: int, tk: int, scale: float, causal: bool):
+    """Unnormalized flash block for the ring composition: one Q tile vs the
+    whole visiting K/V shard, global positions offset by (q_off, k_off)
+    from the scalar operand. Emits (acc, m, l) so the caller's online-
+    softmax merge can combine shards."""
+    from jax import lax
+    import jax.experimental.pallas as pl
+
+    pid_q = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    bq, d = q.shape
+    q_off = off_ref[0]
+    k_off = off_ref[1]
+
+    def body(i, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.dslice(i * block, block), :].astype(jnp.float32)
+        vb = v_ref[0, pl.dslice(i * block, block), :].astype(jnp.float32)
+        s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_off + pid_q * block + lax.broadcasted_iota(
+                jnp.int32, (bq, block), 0)
+            kpos = k_off + i * block + lax.broadcasted_iota(
+                jnp.int32, (bq, block), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = lax.fori_loop(0, tk // block, body, (acc0, m0, l0))
+    acc_ref[0] = acc.astype(acc_ref.dtype)
+    m_ref[0] = m[:, None]
+    l_ref[0] = l[:, None]
+
+
+def flash_attention_block(q, k, v, q_off, k_off, scale, causal):
+    """Per-shard flash block for ring attention: q [B,Tq,H,D] resident,
+    k/v [B,Tk,H,D] visiting, global offsets as traced scalars. Returns
+    (acc [B,Tq,H,D] unnormalized, l [B,H,Tq], m [B,H,Tq]) in f32 carries,
+    matching parallel.ring_attention._block_attn's online-softmax form."""
+    import jax.experimental.pallas as pl
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block = _block(min(tq, tk))
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+
+    interpret = jax.default_backend() != "tpu"
+    vma = getattr(q, "aval", None)
+    vma = getattr(vma, "vma", frozenset()) or frozenset()
+
+    def out_struct(shape, dtype):
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:            # older jax: no vma kwarg
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+    acc, m, l = pl.pallas_call(
+        functools.partial(_block_kernel, block=block, tk=tk,
+                          scale=float(scale), causal=causal),
+        grid=(b * h, tq // block),
+        in_specs=[
+            pl.BlockSpec((2,), lambda i, j: (0,)),
+            pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0)),
+            # trailing singleton keeps the (sublane, lane) tiling legal
+            pl.BlockSpec((1, block, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            out_struct((b * h, tq, d), jnp.float32),
+            out_struct((b * h, tq, 1), jnp.float32),
+            out_struct((b * h, tq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, qh, kh, vh)
+    acc = acc.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    m = m.reshape(b, h, tq)
+    l = l.reshape(b, h, tq)
+    return acc, l, m
+
+
+def block_supports(q, k) -> bool:
+    tq, tk = q.shape[1], k.shape[1]
+    blk = _block(min(tq, tk))
+    return (q.ndim == 4 and tq % blk == 0 and tk % blk == 0
+            and min(tq, tk) >= 8)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q, k, v, causal=False):
     """softmax(QK^T/sqrt(D) [+causal mask]) V over [B, T, H, D]."""

@@ -56,7 +56,7 @@ def _block_attn(q, k, v, scale, mask):
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale=None):
+                   scale=None, use_flash: bool = False):
     """Attention over a sequence sharded on `axis_name` (call inside
     shard_map / pjit with that axis). q/k/v are the LOCAL shards
     [B, T/P, H, D]; returns the local output shard.
@@ -68,18 +68,34 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     p_size = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
-    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    # python-float scale: d is static, and the pallas block kernel needs a
+    # concrete compile-time constant
+    scale = float(scale) if scale is not None else 1.0 / float(d) ** 0.5
 
     q_pos = idx * t_local + jnp.arange(t_local)       # global q positions
 
     def step(carry, _):
         k_cur, v_cur, k_off, acc, l_acc, m_acc, any_valid = carry
-        if causal:
-            kv_pos = k_off + jnp.arange(t_local)
-            mask = q_pos[:, None] >= kv_pos[None, :]
+        if use_flash:
+            # per-shard compute on the Pallas flash kernel
+            # (ops/pallas_attention.flash_attention_block): VMEM online
+            # softmax within the shard, ring merge across shards
+            from ..ops.pallas_attention import flash_attention_block
+            acc_b, l_b, m_b = flash_attention_block(
+                q, k_cur, v_cur, idx * t_local, k_off, scale, causal)
+            valid_b = m_b > -5e29
+            m_b = jnp.where(valid_b, m_b, 0.0)
+            acc_b = acc_b.astype(acc.dtype)
+            l_b = l_b.astype(l_acc.dtype)
+            m_b = m_b.astype(m_acc.dtype)
         else:
-            mask = None
-        acc_b, l_b, m_b, valid_b = _block_attn(q, k_cur, v_cur, scale, mask)
+            if causal:
+                kv_pos = k_off + jnp.arange(t_local)
+                mask = q_pos[:, None] >= kv_pos[None, :]
+            else:
+                mask = None
+            acc_b, l_b, m_b, valid_b = _block_attn(q, k_cur, v_cur, scale,
+                                                   mask)
         # online-softmax merge of (acc, l, m) with the new block. Rows the
         # visiting block fully masks must not move the running max (their
         # clamped m_b of 0.0 would destroy the subtraction invariant when
@@ -120,9 +136,13 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
 
 def ring_attention_sharded(q, k, v, mesh, axis: str = "sp",
-                           causal: bool = False):
+                           causal: bool = False, use_flash: bool = False):
     """Convenience wrapper: global q/k/v [B, T, H, D] -> shard_map the ring
-    over mesh axis `axis` (T must divide by the axis size)."""
+    over mesh axis `axis` (T must divide by the axis size). use_flash=True
+    runs the per-shard block on the Pallas flash kernel (flash within the
+    shard, ring across shards — the long-context composition); backward
+    recomputes through the einsum ring (custom_vjp, same tradeoff as
+    ops/pallas_attention.flash_attention)."""
     from jax.sharding import PartitionSpec as P
     try:
         from jax import shard_map
@@ -131,9 +151,40 @@ def ring_attention_sharded(q, k, v, mesh, axis: str = "sp",
 
     spec = P(None, axis, None, None)
 
-    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
-    def run(ql, kl, vl):
-        return ring_attention(ql, kl, vl, axis_name=axis, causal=causal)
+    def _make(flash):
+        # check_vma off on the flash path: the pallas HLO interpreter's
+        # dynamic_slice hits a varying-manifest false positive when inputs
+        # alias (jax suggests exactly this workaround in its error)
+        kw = {"check_vma": False} if flash else {}
+        try:
+            sm = functools.partial(shard_map, mesh=mesh,
+                                   in_specs=(spec, spec, spec),
+                                   out_specs=spec, **kw)
+        except TypeError:            # older jax: no check_vma kwarg
+            sm = functools.partial(shard_map, mesh=mesh,
+                                   in_specs=(spec, spec, spec),
+                                   out_specs=spec)
 
-    return run(q, k, v)
+        @sm
+        def run(ql, kl, vl):
+            return ring_attention(ql, kl, vl, axis_name=axis,
+                                  causal=causal, use_flash=flash)
+        return run
+
+    if not use_flash:
+        return _make(False)(q, k, v)
+
+    @jax.custom_vjp
+    def flash_ring(q, k, v):
+        return _make(True)(q, k, v)
+
+    def fwd(q, k, v):
+        return _make(True)(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        qr, kr, vr = res
+        _, vjp = jax.vjp(_make(False), qr, kr, vr)
+        return vjp(g)
+
+    flash_ring.defvjp(fwd, bwd)
+    return flash_ring(q, k, v)
