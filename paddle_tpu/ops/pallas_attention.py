@@ -41,41 +41,31 @@ def _block(t: int) -> int:
     return 128 if t % 128 == 0 else t
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, block: int, t: int, scale: float,
-            causal: bool):
+def _flash_loop(q, k_ref, v_ref, block, n_live, causal, q_base, k_base):
+    """Shared online-softmax inner loop over K tiles: q [BQ, D] pre-scaled,
+    k/v read from VMEM refs, global positions q_base + row / k_base +
+    i*block + col for causal masking. Returns unnormalized (acc, m, l)."""
     from jax import lax
     import jax.experimental.pallas as pl
 
-    pid_q = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
-    bq = q.shape[0]
-    d = q.shape[1]
-
-    n_k = t // block
-    if causal:
-        # blocks strictly past the diagonal contribute nothing; with
-        # BQ == BK the diagonal block is index pid_q
-        n_live = pid_q + 1
-    else:
-        n_live = n_k
+    bq, d = q.shape
 
     def body(i, carry):
         acc, m, l = carry
         kb = k_ref[0, pl.dslice(i * block, block), :].astype(jnp.float32)
         vb = v_ref[0, pl.dslice(i * block, block), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
         if causal:
-            qpos = pid_q * block + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block), 0)
-            kpos = i * block + jax.lax.broadcasted_iota(
+            qpos = q_base + lax.broadcasted_iota(jnp.int32, (bq, block), 0)
+            kpos = k_base + i * block + lax.broadcasted_iota(
                 jnp.int32, (bq, block), 1)
             s = jnp.where(qpos >= kpos, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+        acc_new = acc * corr[:, None] + lax.dot_general(
             p, vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
@@ -83,7 +73,21 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block: int, t: int, scale: float,
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m0 = jnp.full((bq,), _NEG, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = lax.fori_loop(0, n_live, body, (acc0, m0, l0))
+    return lax.fori_loop(0, n_live, body, (acc0, m0, l0))
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block: int, t: int, scale: float,
+            causal: bool):
+    import jax.experimental.pallas as pl
+
+    pid_q = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    n_k = t // block
+    # blocks strictly past the diagonal contribute nothing; with BQ == BK
+    # the diagonal block is index pid_q
+    n_live = (pid_q + 1) if causal else n_k
+    acc, m, l = _flash_loop(q, k_ref, v_ref, block, n_live, causal,
+                            pid_q * block, 0)
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
 
 
@@ -122,40 +126,22 @@ def _block_kernel(off_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
     whole visiting K/V shard, global positions offset by (q_off, k_off)
     from the scalar operand. Emits (acc, m, l) so the caller's online-
     softmax merge can combine shards."""
-    from jax import lax
     import jax.experimental.pallas as pl
 
     pid_q = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
-    bq, d = q.shape
     q_off = off_ref[0]
     k_off = off_ref[1]
-
-    def body(i, carry):
-        acc, m, l = carry
-        kb = k_ref[0, pl.dslice(i * block, block), :].astype(jnp.float32)
-        vb = v_ref[0, pl.dslice(i * block, block), :].astype(jnp.float32)
-        s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        if causal:
-            qpos = q_off + pid_q * block + lax.broadcasted_iota(
-                jnp.int32, (bq, block), 0)
-            kpos = k_off + i * block + lax.broadcasted_iota(
-                jnp.int32, (bq, block), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
-
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), _NEG, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = lax.fori_loop(0, tk // block, body, (acc0, m0, l0))
+    n_k = tk // block
+    if causal:
+        # prune K blocks entirely past this Q tile's last row: a visiting
+        # shard fully in the future costs zero MXU work (n_live = 0)
+        q_last = q_off + pid_q * block + (block - 1)
+        n_live = jnp.clip((q_last - k_off) // block + 1, 0, n_k)
+    else:
+        n_live = n_k
+    acc, m, l = _flash_loop(q, k_ref, v_ref, block, n_live, causal,
+                            q_off + pid_q * block, k_off)
     acc_ref[0] = acc.astype(acc_ref.dtype)
     m_ref[0] = m[:, None]
     l_ref[0] = l[:, None]
@@ -171,6 +157,9 @@ def flash_attention_block(q, k, v, q_off, k_off, scale, causal):
     b, tq, h, d = q.shape
     tk = k.shape[1]
     block = _block(min(tq, tk))
+    assert tq % block == 0 and tk % block == 0, (
+        f"flash_attention_block needs tileable shapes (tq={tq}, tk={tk}, "
+        f"block={block}); gate callers with block_supports()")
     qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
     vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)

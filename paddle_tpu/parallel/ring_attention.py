@@ -68,9 +68,16 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     p_size = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
-    # python-float scale: d is static, and the pallas block kernel needs a
-    # concrete compile-time constant
-    scale = float(scale) if scale is not None else 1.0 / float(d) ** 0.5
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
+    elif use_flash:
+        # the pallas block kernel bakes scale in as a compile-time
+        # constant; traced scales stay supported on the einsum path
+        scale = float(scale)
+    if use_flash:
+        from ..ops.pallas_attention import block_supports
+        if not block_supports(q, k):
+            use_flash = False        # shard shapes not tileable: einsum
 
     q_pos = idx * t_local + jnp.arange(t_local)       # global q positions
 
@@ -154,16 +161,20 @@ def ring_attention_sharded(q, k, v, mesh, axis: str = "sp",
     def _make(flash):
         # check_vma off on the flash path: the pallas HLO interpreter's
         # dynamic_slice hits a varying-manifest false positive when inputs
-        # alias (jax suggests exactly this workaround in its error)
-        kw = {"check_vma": False} if flash else {}
-        try:
-            sm = functools.partial(shard_map, mesh=mesh,
-                                   in_specs=(spec, spec, spec),
-                                   out_specs=spec, **kw)
-        except TypeError:            # older jax: no check_vma kwarg
-            sm = functools.partial(shard_map, mesh=mesh,
-                                   in_specs=(spec, spec, spec),
-                                   out_specs=spec)
+        # alias (jax suggests exactly this workaround in its error).
+        # Probe the signature — functools.partial would defer an unknown-
+        # kwarg TypeError to the call site, past any try/except here.
+        kw = {}
+        if flash:
+            import inspect
+            try:
+                if "check_vma" in inspect.signature(shard_map).parameters:
+                    kw["check_vma"] = False
+            except (TypeError, ValueError):
+                pass
+        sm = functools.partial(shard_map, mesh=mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=spec, **kw)
 
         @sm
         def run(ql, kl, vl):
