@@ -2,19 +2,36 @@
 tier of the compute path; /opt/skills/guides/pallas_guide.md patterns).
 
 Forward: online-softmax blocks — Q tiles stay resident in VMEM while K/V
-tiles stream through, carrying the running max/denominator, so the [T, T]
-score matrix never materializes in HBM (memory O(T) instead of O(T^2),
-same contract as parallel/ring_attention.py across chips but within one
-core's VMEM).
+tiles stream through as the innermost (sequential) grid dim, carrying the
+running max/denominator in VMEM scratch, so the [T, T] score matrix never
+materializes in HBM and VMEM use is O(tile) — T is unbounded (memory
+O(T) end to end, same contract as parallel/ring_attention.py across chips
+but within one core's VMEM).
 
-Backward: jax.custom_vjp recomputes through the reference attention —
-the standard recompute tradeoff; gradients are bitwise those of
-attention_reference, which the ring-attention tests already validate.
+Backward: the standard flash backward (FlashAttention-2 style) — the
+forward saves only the per-row logsumexp (m + log l); the backward
+recomputes score blocks in VMEM from (Q, K, LSE) and accumulates
+dQ (one kernel, Q tiles resident, K/V streaming) and dK/dV (a second
+kernel, K/V tiles resident, Q/dO streaming). Both kernels take global
+(q_off, k_off) position offsets so the same code serves the single-device
+path (offsets 0) and the per-shard blocks of the ring composition
+(parallel/ring_attention.py flash_ring backward).
 
-On CPU (the test mesh) the kernel runs under the Pallas interpreter
+Layout: operands stay in the model's [B, T, H, D] — tiles span the FULL
+(H, D) trailing dims (Mosaic-legal: equal to the array dims) and the
+kernels loop heads in an unrolled Python loop, so no head-major transpose
+copies bracket the kernels (they dominated wall time in transformer
+training, where T is moderate and attention is called per layer).
+Precision: dots take the input dtype (bf16 rides the MXU's half-precision
+datapath) with f32 ACCUMULATION via preferred_element_type; softmax
+statistics and scaling run in f32; P/dS are cast back to the input dtype
+for their matmuls — the FlashAttention-2 recipe.
+
+On CPU (the test mesh) the kernels run under the Pallas interpreter
 (interpret=True) — same code path, no Mosaic compile. Shapes must tile:
-T divisible by the block (128, or T itself when smaller); callers
-fall back to attention_reference otherwise (ops/nn_ops.py wiring).
+T divisible by the block (128, or T itself when smaller; sublane-aligned
+T % 8 == 0); callers fall back to attention_reference otherwise
+(ops/nn_ops.py wiring).
 """
 
 from __future__ import annotations
@@ -30,144 +47,150 @@ _NEG = -1e30
 
 
 def supports(q, k, v) -> bool:
-    """Static-shape eligibility: [B, T, H, D] with T tileable."""
+    """Static-shape eligibility: [B, T, H, D] with T tileable and
+    sublane-aligned (T % 8 == 0 — Mosaic tiles (8, 128) for f32)."""
     if q.ndim != 4 or q.shape != k.shape or q.shape != v.shape:
         return False
     t = q.shape[1]
-    return t >= 8 and (t <= 128 or t % 128 == 0)
+    return t >= 8 and t % 8 == 0 and (t <= 128 or t % 128 == 0)
 
 
 def _block(t: int) -> int:
     return 128 if t % 128 == 0 else t
 
 
-def _flash_loop(q, k_ref, v_ref, block, n_live, causal, q_base, k_base):
-    """Shared online-softmax inner loop over K tiles: q [BQ, D] pre-scaled,
-    k/v read from VMEM refs, global positions q_base + row / k_base +
-    i*block + col for causal masking. Returns unnormalized (acc, m, l)."""
+def _block_k(t: int) -> int:
+    """Streamed-side (K or Q) tile rows: larger tiles amortize MXU matmul
+    setup — the per-block dots contract over D (= 64 typically), so the
+    streamed dimension is the only one free to grow. Capped by an env
+    knob for tuning; must divide t."""
+    import os
+    cap = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK_K", "512"))
+    b = _block(t)
+    while b * 2 <= cap and t % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def _interpret() -> bool:
+    """Mosaic-compile only when actually lowering for TPU. The executor
+    targets its place's device via jax.default_device — which
+    jax.default_backend() ignores — so a CPUPlace run in a TPU-default
+    process (the axon terminal) must still take the interpreter."""
+    dev = jax.config.jax_default_device
+    if dev is not None:
+        platform = getattr(dev, "platform", None)
+        if platform is not None:
+            return platform != "tpu"
+    return jax.default_backend() != "tpu"
+
+
+def _compiler_params(semantics):
+    """Declare grid-dimension semantics so Mosaic can overlap tile DMA
+    with compute: "parallel" dims carry nothing across iterations;
+    "arbitrary" marks the streamed innermost dim whose scratch
+    accumulators DO carry. vmem_limit raised past the 16 MB default: the
+    unrolled head loop keeps H tiles' intermediates live (v5e has 128 MB
+    physical VMEM; 64 MB leaves headroom for double-buffered DMA)."""
+    if _interpret():
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.CompilerParams(dimension_semantics=tuple(semantics),
+                                vmem_limit_bytes=64 * 1024 * 1024)
+
+
+def _scratch(shape):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+_SEM3 = ("parallel", "parallel", "arbitrary")
+
+
+def _dot(a, b, dims):
     from jax import lax
+    return lax.dot_general(a, b, (dims, ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+def _causal_mask(s, q_first, k_first, bq, bk):
+    from jax import lax
+    qpos = q_first + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k_first + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(qpos >= kpos, s, _NEG)
+
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *, bq: int, bk: int, n_h: int,
+                n_k: int, scale: float, causal: bool, normalize: bool):
+    """Grid (B, n_q, n_k): Q tile [bq, H, D] resident, K/V tiles
+    [bk, H, D] streamed innermost; unrolled head loop; (acc, m, l) carry
+    in scratch with a leading head axis. normalize=True emits
+    (softmax(S)V, LSE) — the single-device forward; normalize=False emits
+    the raw (acc, m, l) — the per-shard block the ring merge consumes."""
     import jax.experimental.pallas as pl
 
-    bq, d = q.shape
-
-    def body(i, carry):
-        acc, m, l = carry
-        kb = k_ref[0, pl.dslice(i * block, block), :].astype(jnp.float32)
-        vb = v_ref[0, pl.dslice(i * block, block), :].astype(jnp.float32)
-        s = lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        if causal:
-            qpos = q_base + lax.broadcasted_iota(jnp.int32, (bq, block), 0)
-            kpos = k_base + i * block + lax.broadcasted_iota(
-                jnp.int32, (bq, block), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
-
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), _NEG, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    return lax.fori_loop(0, n_live, body, (acc0, m0, l0))
-
-
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, block: int, t: int, scale: float,
-            causal: bool):
-    import jax.experimental.pallas as pl
-
-    pid_q = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
-    n_k = t // block
-    # blocks strictly past the diagonal contribute nothing; with BQ == BK
-    # the diagonal block is index pid_q
-    n_live = (pid_q + 1) if causal else n_k
-    acc, m, l = _flash_loop(q, k_ref, v_ref, block, n_live, causal,
-                            pid_q * block, 0)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
-
-
-def _forward(q, k, v, causal):
-    import jax.experimental.pallas as pl
-
-    b, t, h, d = q.shape
-    block = _block(t)
-    scale = 1.0 / (d ** 0.5)
-    # [B, T, H, D] -> [B*H, T, D]: heads become independent grid rows
-    qh = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    kh = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vh = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
-    interpret = jax.default_backend() != "tpu"
-    grid = (b * h, t // block)
-    out = pl.pallas_call(
-        functools.partial(_kernel, block=block, t=t, scale=scale,
-                          causal=causal),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-        interpret=interpret,
-    )(qh, kh, vh)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-
-
-def _block_kernel(off_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
-                  block: int, tk: int, scale: float, causal: bool):
-    """Unnormalized flash block for the ring composition: one Q tile vs the
-    whole visiting K/V shard, global positions offset by (q_off, k_off)
-    from the scalar operand. Emits (acc, m, l) so the caller's online-
-    softmax merge can combine shards."""
-    import jax.experimental.pallas as pl
-
-    pid_q = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    i = pl.program_id(1)
+    j = pl.program_id(2)
     q_off = off_ref[0]
     k_off = off_ref[1]
-    n_k = tk // block
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, _NEG)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    def compute():
+        # full-tile loads + value-level head slices: Mosaic's bf16 layout
+        # inference rejects (1, rows, 1, d) ref-slice reshapes, and whole
+        # tiles give it freedom to keep the packed layout
+        qt = q_ref[0]                                 # [bq, H, D]
+        kt = k_ref[0]
+        vt = v_ref[0]
+        for hh in range(n_h):
+            q = qt[:, hh, :]                          # [bq, D]
+            s = _dot(q, kt[:, hh, :], ((1,), (1,))) * scale
+            if causal:
+                s = _causal_mask(s, q_off + i * bq, k_off + j * bk, bq, bk)
+            m_prev = m_sc[hh, :, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            corr = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            l_sc[hh, :, 0] = l_sc[hh, :, 0] * corr + jnp.sum(p, axis=-1)
+            acc_sc[hh] = acc_sc[hh] * corr[:, None] + _dot(
+                p.astype(q.dtype), vt[:, hh, :], ((1,), (0,)))
+            m_sc[hh, :, 0] = m_new
+
     if causal:
-        # prune K blocks entirely past this Q tile's last row: a visiting
-        # shard fully in the future costs zero MXU work (n_live = 0)
-        q_last = q_off + pid_q * block + (block - 1)
-        n_live = jnp.clip((q_last - k_off) // block + 1, 0, n_k)
+        # K tiles strictly past this Q tile's last row are dead: skip the
+        # MXU work (the tile DMA still streams — grids are static)
+        pl.when(q_off + i * bq + (bq - 1) >= k_off + j * bk)(compute)
     else:
-        n_live = n_k
-    acc, m, l = _flash_loop(q, k_ref, v_ref, block, n_live, causal,
-                            q_off + pid_q * block, k_off)
-    acc_ref[0] = acc.astype(acc_ref.dtype)
-    m_ref[0] = m[:, None]
-    l_ref[0] = l[:, None]
+        compute()
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        outs, stats = [], []
+        for hh in range(n_h):
+            if normalize:
+                l = l_sc[hh, :, 0]
+                outs.append((acc_sc[hh] /
+                             jnp.maximum(l, 1e-30)[:, None]))
+                # per-row logsumexp of the scaled scores — the only
+                # residual the flash backward needs beyond (q, k, v, o)
+                stats.append((m_sc[hh, :, 0] +
+                              jnp.log(jnp.maximum(l, 1e-30)))[:, None])
+            else:
+                outs.append(acc_sc[hh])
+                stats.append(jnp.stack([m_sc[hh, :, 0], l_sc[hh, :, 0]],
+                                       axis=1))
+        o_ref[0] = jnp.stack(outs, axis=1).astype(o_ref.dtype)
+        lse_ref[0] = jnp.stack(stats, axis=1)
 
 
-def flash_attention_block(q, k, v, q_off, k_off, scale, causal):
-    """Per-shard flash block for ring attention: q [B,Tq,H,D] resident,
-    k/v [B,Tk,H,D] visiting, global offsets as traced scalars. Returns
-    (acc [B,Tq,H,D] unnormalized, l [B,H,Tq], m [B,H,Tq]) in f32 carries,
-    matching parallel.ring_attention._block_attn's online-softmax form."""
-    import jax.experimental.pallas as pl
-
-    b, tq, h, d = q.shape
-    tk = k.shape[1]
-    block = _block(min(tq, tk))
-    assert tq % block == 0 and tk % block == 0, (
-        f"flash_attention_block needs tileable shapes (tq={tq}, tk={tk}, "
-        f"block={block}); gate callers with block_supports()")
-    qh = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    kh = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    vh = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
-                      jnp.asarray(k_off, jnp.int32)])
-
-    interpret = jax.default_backend() != "tpu"
-    vma = getattr(q, "aval", None)
+def _vma_struct(like):
+    vma = getattr(like, "aval", None)
     vma = getattr(vma, "vma", frozenset()) or frozenset()
 
     def out_struct(shape, dtype):
@@ -176,32 +199,69 @@ def flash_attention_block(q, k, v, q_off, k_off, scale, causal):
         except TypeError:            # older jax: no vma kwarg
             return jax.ShapeDtypeStruct(shape, dtype)
 
-    acc, m, l = pl.pallas_call(
-        functools.partial(_block_kernel, block=block, tk=tk,
-                          scale=float(scale), causal=causal),
-        grid=(b * h, tq // block),
+    return out_struct
+
+
+def _fwd_call(q, k, v, q_off, k_off, scale, causal, normalize):
+    import jax.experimental.pallas as pl
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    bq = _block(min(tq, tk))
+    bk = _block_k(tk)
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    out_struct = _vma_struct(q)
+    stat_last = 1 if normalize else 2
+
+    out, stats = pl.pallas_call(
+        functools.partial(_fwd_kernel, bq=bq, bk=bk, n_h=h, n_k=tk // bk,
+                          scale=float(scale), causal=causal,
+                          normalize=normalize),
+        grid=(b, tq // bq, tk // bk),
         in_specs=[
-            pl.BlockSpec((2,), lambda i, j: (0,)),
-            pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((2,), lambda bb, j, kk: (0,)),
+            pl.BlockSpec((1, bq, h, d), lambda bb, j, kk: (bb, j, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda bb, j, kk: (bb, kk, 0, 0)),
+            pl.BlockSpec((1, bk, h, d), lambda bb, j, kk: (bb, kk, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0)),
-            # trailing singleton keeps the (sublane, lane) tiling legal
-            pl.BlockSpec((1, block, 1), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bq, h, d), lambda bb, j, kk: (bb, j, 0, 0)),
+            pl.BlockSpec((1, bq, h, stat_last),
+                         lambda bb, j, kk: (bb, j, 0, 0)),
         ],
         out_shape=[
-            out_struct((b * h, tq, d), jnp.float32),
-            out_struct((b * h, tq, 1), jnp.float32),
-            out_struct((b * h, tq, 1), jnp.float32),
+            out_struct((b, tq, h, d),
+                       q.dtype if normalize else jnp.float32),
+            out_struct((b, tq, h, stat_last), jnp.float32),
         ],
-        interpret=interpret,
-    )(offs, qh, kh, vh)
-    acc = acc.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
-    m = m.reshape(b, h, tq)
-    l = l.reshape(b, h, tq)
+        scratch_shapes=[_scratch((h, bq, d)), _scratch((h, bq, 1)),
+                        _scratch((h, bq, 1))],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(_SEM3),
+    )(offs, q, k, v)
+    return out, stats
+
+
+def _forward(q, k, v, causal, return_lse=False):
+    d = q.shape[-1]
+    scale = 1.0 / (d ** 0.5)
+    out, lse = _fwd_call(q, k, v, 0, 0, scale, causal, normalize=True)
+    if return_lse:
+        # [B, T, H, 1] -> [B, H, T]: tiny (no D axis) transpose
+        return out, lse[..., 0].transpose(0, 2, 1)
+    return out
+
+
+def flash_attention_block(q, k, v, q_off, k_off, scale, causal):
+    """Per-shard flash block for ring attention: q [B,Tq,H,D] resident,
+    k/v [B,Tk,H,D] visiting, global offsets as traced scalars. Returns
+    (acc [B,Tq,H,D] unnormalized, l [B,H,Tq], m [B,H,Tq]) in f32 carries,
+    matching parallel.ring_attention._block_attn's online-softmax form."""
+    acc, stats = _fwd_call(q, k, v, q_off, k_off, scale, causal,
+                           normalize=False)
+    m = stats[..., 0].transpose(0, 2, 1)
+    l = stats[..., 1].transpose(0, 2, 1)
     return acc, l, m
 
 
@@ -209,7 +269,184 @@ def block_supports(q, k) -> bool:
     tq, tk = q.shape[1], k.shape[1]
     blk = _block(min(tq, tk))
     return (q.ndim == 4 and tq % blk == 0 and tk % blk == 0
-            and min(tq, tk) >= 8)
+            and min(tq, tk) >= 8 and tq % 8 == 0 and tk % 8 == 0)
+
+
+def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+               dq_ref, dq_sc, *, bq: int, bk: int, n_h: int, n_k: int,
+               scale: float, causal: bool):
+    """Grid (B, n_q, n_k), K/V STREAMED innermost (wide bk tiles) with a
+    per-head dQ scratch carry. Recomputes P = exp(S - LSE) per block;
+    dS = P*(dO V^T - delta); dQ = (sum_k dS K) * scale. Causal: K blocks
+    fully past the Q tile's last row skip their MXU work."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    q_off = off_ref[0]
+    k_off = off_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    def compute():
+        qt = q_ref[0]
+        kt = k_ref[0]
+        vt = v_ref[0]
+        dot_ = do_ref[0]
+        lset = lse_ref[0].astype(jnp.float32)
+        dlt = dl_ref[0].astype(jnp.float32)
+        for hh in range(n_h):
+            q = qt[:, hh, :]
+            kb = kt[:, hh, :]
+            s = _dot(q, kb, ((1,), (1,))) * scale
+            if causal:
+                s = _causal_mask(s, q_off + i * bq, k_off + j * bk, bq, bk)
+            p = jnp.exp(s - lset[:, hh, :])
+            dp = _dot(dot_[:, hh, :], vt[:, hh, :], ((1,), (1,)))
+            ds = (p * (dp - dlt[:, hh, :])).astype(q.dtype)
+            dq_sc[hh] = dq_sc[hh] + _dot(ds, kb, ((1,), (0,)))
+
+    if causal:
+        pl.when(q_off + i * bq + (bq - 1) >= k_off + j * bk)(compute)
+    else:
+        compute()
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        dq_ref[0] = jnp.stack([dq_sc[hh] * scale for hh in range(n_h)],
+                              axis=1).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                dk_ref, dv_ref, dk_sc, dv_sc, *, bq: int, bk: int,
+                n_h: int, n_q: int, scale: float, causal: bool):
+    """Grid (B, n_k, n_q), Q/dO/LSE/delta STREAMED innermost (wide bq
+    tiles) with per-head dK/dV scratch carries. dV = sum_q P^T dO;
+    dK = (sum_q dS^T Q) * scale. Causal: Q blocks fully before the K
+    tile's first column skip their MXU work."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(1)   # k tile
+    j = pl.program_id(2)   # q tile (streamed)
+    q_off = off_ref[0]
+    k_off = off_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    def compute():
+        kt = k_ref[0]
+        vt = v_ref[0]
+        qt = q_ref[0]
+        dot_ = do_ref[0]
+        lset = lse_ref[0].astype(jnp.float32)
+        dlt = dl_ref[0].astype(jnp.float32)
+        for hh in range(n_h):
+            kb = kt[:, hh, :]
+            qb = qt[:, hh, :]
+            dob = dot_[:, hh, :]
+            s = _dot(qb, kb, ((1,), (1,))) * scale
+            if causal:
+                s = _causal_mask(s, q_off + j * bq, k_off + i * bk, bq, bk)
+            p = jnp.exp(s - lset[:, hh, :])
+            dv_sc[hh] = dv_sc[hh] + _dot(p.astype(kb.dtype), dob,
+                                         ((0,), (0,)))
+            dp = _dot(dob, vt[:, hh, :], ((1,), (1,)))
+            ds = (p * (dp - dlt[:, hh, :])).astype(kb.dtype)
+            dk_sc[hh] = dk_sc[hh] + _dot(ds, qb, ((0,), (0,)))
+
+    if causal:
+        pl.when(q_off + j * bq + (bq - 1) >= k_off + i * bk)(compute)
+    else:
+        compute()
+
+    @pl.when(j == n_q - 1)
+    def _finalize():
+        dk_ref[0] = jnp.stack([dk_sc[hh] * scale for hh in range(n_h)],
+                              axis=1).astype(dk_ref.dtype)
+        dv_ref[0] = jnp.stack([dv_sc[hh] for hh in range(n_h)],
+                              axis=1).astype(dv_ref.dtype)
+
+
+def flash_attention_bwd_block(q, k, v, do, lse, delta, q_off, k_off, scale,
+                              causal):
+    """Flash backward for one (Q shard, K/V shard) pair with global position
+    offsets: q/do [B,Tq,H,D], k/v [B,Tk,H,D], lse/delta [B,H,Tq] (scaled-
+    score logsumexp from the forward; delta = rowsum(dO*O)). Returns
+    (dq, dk, dv) in the inputs' dtypes. Offsets (0, 0) with Tq == Tk == T
+    is exactly the single-device flash backward; the ring backward calls it
+    per visiting shard (parallel/ring_attention.py)."""
+    import jax.experimental.pallas as pl
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block = _block(min(tq, tk))
+    assert tq % block == 0 and tk % block == 0, (
+        f"flash_attention_bwd_block needs tileable shapes (tq={tq}, "
+        f"tk={tk}, block={block}); gate callers with block_supports()")
+    # resident tiles stay at `block`; the STREAMED side gets wide tiles
+    # (dq streams K, dkv streams Q — see _block_k)
+    bq_w = _block_k(tq)
+    bk_w = _block_k(tk)
+    # rows no shard ever validated carry lse = -inf (possible only for
+    # non-causal corner cases); push them to +big so exp(s - lse) == 0 and
+    # they contribute nothing to any gradient. Operands stay [B,T,H,D];
+    # the row stats become [B,T,H,1] (tiny transposes — no D axis).
+    lseh = jnp.where(jnp.isfinite(lse), lse, 1e30).astype(
+        jnp.float32).transpose(0, 2, 1)[..., None]
+    dlh = delta.astype(jnp.float32).transpose(0, 2, 1)[..., None]
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+
+    interpret = _interpret()
+    out_struct = _vma_struct(q)
+
+    off_spec = pl.BlockSpec((2,), lambda bb, j, kk: (0,))
+
+    def res_spec(rows, d_):
+        return pl.BlockSpec((1, rows, h, d_),
+                            lambda bb, j, kk: (bb, j, 0, 0))
+
+    def stream_spec(rows, d_):
+        return pl.BlockSpec((1, rows, h, d_),
+                            lambda bb, j, kk: (bb, kk, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, bq=block, bk=bk_w, n_h=h,
+                          n_k=tk // bk_w, scale=float(scale),
+                          causal=causal),
+        grid=(b, tq // block, tk // bk_w),
+        in_specs=[off_spec, res_spec(block, d), stream_spec(bk_w, d),
+                  stream_spec(bk_w, d), res_spec(block, d),
+                  res_spec(block, 1), res_spec(block, 1)],
+        out_specs=res_spec(block, d),
+        out_shape=out_struct((b, tq, h, d), q.dtype),
+        scratch_shapes=[_scratch((h, block, d))],
+        interpret=interpret,
+        compiler_params=_compiler_params(_SEM3),
+    )(offs, q, k, v, do, lseh, dlh)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, bq=bq_w, bk=block, n_h=h,
+                          n_q=tq // bq_w, scale=float(scale),
+                          causal=causal),
+        grid=(b, tk // block, tq // bq_w),
+        in_specs=[off_spec, stream_spec(bq_w, d), res_spec(block, d),
+                  res_spec(block, d), stream_spec(bq_w, d),
+                  stream_spec(bq_w, 1), stream_spec(bq_w, 1)],
+        out_specs=[res_spec(block, d), res_spec(block, d)],
+        out_shape=[out_struct((b, tk, h, d), k.dtype),
+                   out_struct((b, tk, h, d), v.dtype)],
+        scratch_shapes=[_scratch((h, block, d)), _scratch((h, block, d))],
+        interpret=interpret,
+        compiler_params=_compiler_params(_SEM3),
+    )(offs, q, k, v, do, lseh, dlh)
+
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -219,16 +456,18 @@ def flash_attention(q, k, v, causal=False):
 
 
 def _fwd(q, k, v, causal):
-    return _forward(q, k, v, causal), (q, k, v)
+    o, lse = _forward(q, k, v, causal, return_lse=True)
+    return o, (q, k, v, o, lse)
 
 
 def _bwd(causal, res, g):
-    from ..parallel.ring_attention import attention_reference
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: attention_reference(a, b, c,
-                                                         causal=causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    # delta_i = dO_i . O_i  — the softmax-jacobian row correction
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).transpose(0, 2, 1)       # [B, H, T]
+    return flash_attention_bwd_block(q, k, v, g, lse, delta, 0, 0, scale,
+                                     causal)
 
 
 flash_attention.defvjp(_fwd, _bwd)

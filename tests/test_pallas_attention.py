@@ -35,36 +35,58 @@ class TestFlashKernel:
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=2e-5, atol=2e-6)
 
-    def test_gradients_match_reference(self):
-        q, k, v = _qkv(1, 128, 2, 32)
+    @pytest.mark.parametrize("shape", [(1, 128, 2, 32), (2, 256, 2, 64)])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match_reference(self, shape, causal):
+        """The Pallas flash backward (dQ/dK/dV kernels recomputing from the
+        saved logsumexp) vs autodiff through the einsum reference. A
+        different algorithm at f32: tolerance 1e-3 abs (grads are O(1)
+        here), the VERDICT r3 acceptance bar."""
+        q, k, v = _qkv(*shape)
         with jax.default_matmul_precision("highest"):
             g1 = jax.grad(lambda a, b, c: jnp.sum(
-                flash_attention(a, b, c, True) ** 2), argnums=(0, 1, 2))(
+                flash_attention(a, b, c, causal) ** 2), argnums=(0, 1, 2))(
                     q, k, v)
             g2 = jax.grad(lambda a, b, c: jnp.sum(
-                attention_reference(a, b, c, causal=True) ** 2),
+                attention_reference(a, b, c, causal=causal) ** 2),
                 argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=2e-5, atol=2e-6)
+                                       rtol=1e-3, atol=1e-3)
 
     def test_supports_gating(self):
-        # T <= 128 takes the block = T path (works untiled); larger T must
-        # tile by 128; rank-3 inputs are rejected
-        assert supports(*_qkv(1, 100, 2, 32))
+        # T <= 128 takes the block = T path (works untiled, but must be
+        # sublane-aligned: T % 8); larger T must tile by 128; rank-3
+        # inputs are rejected
+        assert supports(*_qkv(1, 104, 2, 32))
         assert supports(*_qkv(1, 256, 1, 64))
         assert supports(*_qkv(1, 64, 1, 64))
+        assert not supports(*_qkv(1, 100, 2, 32))   # 100 % 8 != 0
         assert not supports(*_qkv(1, 257, 1, 64))
         q3 = jnp.zeros((2, 64, 32))
         assert not supports(q3, q3, q3)
 
     def test_sub128_untiled_path_matches(self):
-        q, k, v = _qkv(1, 100, 1, 32)       # block = T = 100
+        q, k, v = _qkv(1, 104, 1, 32)       # block = T = 104 (untiled)
         with jax.default_matmul_precision("highest"):
             got = flash_attention(q, k, v, True)
             want = attention_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-6)
+
+    def test_saved_lse_matches_reference(self):
+        """The forward's saved logsumexp equals log-sum-exp of the scaled
+        (masked) scores — the invariant the backward kernels rely on."""
+        from paddle_tpu.ops.pallas_attention import _forward
+        q, k, v = _qkv(1, 128, 2, 32)
+        with jax.default_matmul_precision("highest"):
+            _, lse = _forward(q, k, v, True, return_lse=True)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(q.shape[-1])
+            mask = jnp.tril(jnp.ones((128, 128), bool))
+            s = jnp.where(mask, s, -jnp.inf)
+            want = jax.scipy.special.logsumexp(s, axis=-1)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
 
 
 class TestFlashThroughProgram:
@@ -104,11 +126,13 @@ class TestFlashThroughProgram:
 
 class TestFlashRingComposition:
     def test_flash_within_shard_ring_across(self):
-        """ring_attention_sharded(use_flash=True): the Pallas block kernel
-        computes each shard's contribution, the ring merges across shards —
-        output and gradients must match plain attention. 2-device mesh:
-        interpret-mode pallas inside shard_map compiles slowly, and the
-        composition logic is device-count independent."""
+        """ring_attention_sharded(use_flash=True): the Pallas block kernels
+        compute each shard's contribution in BOTH directions (forward
+        online-softmax; backward dQ/dK/dV from saved LSE, with the dK/dV
+        accumulators riding the ring) — output and gradients must match
+        plain attention. 2-device mesh: interpret-mode pallas inside
+        shard_map compiles slowly, and the composition logic is
+        device-count independent."""
         from jax.sharding import Mesh
         mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
         q, k, v = _qkv(1, 64, 1, 16)
@@ -127,6 +151,8 @@ class TestFlashRingComposition:
                 argnums=(0, 1, 2))(q, k, v)
             g2 = jax.grad(lambda a, b, c: jnp.sum(attention_reference(
                 a, b, c, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        # flash backward recomputes from LSE — a different algorithm at
+        # f32, so 1e-3-class tolerance (same bar as the kernel tests)
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=2e-5, atol=2e-6)
+                                       rtol=1e-3, atol=1e-3)
