@@ -1,17 +1,27 @@
 #!/usr/bin/env python
-"""Flagship benchmark: ResNet-50 training throughput (images/sec) on one chip.
+"""Benchmarks vs the reference's published table (BASELINE.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Baseline anchor: the reference's best published ResNet-50 training number,
-81.69 images/sec (train bs64, MKL-DNN, 2x Xeon 6148 — see BASELINE.md §4;
-the reference publishes no GPU ResNet-50 number). vs_baseline = value/81.69.
-
-BENCH_MODE=lstm benchmarks the reference's RNN config instead (IMDB text
-classification, embedding128 -> 2x[fc + peephole LSTM h512] -> fc2, seqlen
-100 padded, bs64 — reference benchmark/README.md:100-120,
-benchmark/paddle/rnn/rnn.py): JSON line reports ms/batch against the
-published 184 ms/batch on K40m.
+BENCH_MODE selects the config family:
+  resnet (default)   ResNet-50 train bs256 AMP-O2, vs 81.69 img/s
+                     (reference's best published ResNet-50 train,
+                     MKL-DNN 2x Xeon 6148, BASELINE.md §4)
+  alexnet            AlexNet train, vs 626.53 img/s (§4 bs256)
+  googlenet          GoogleNet train, vs 250.46 img/s (§4 bs64)
+  vgg19              VGG-19 train, vs 28.46 img/s (§4 bs64)
+  resnet_infer       ResNet-50 inference bs16, vs 217.69 img/s (§4)
+  alexnet_infer      AlexNet inference bs16, vs 850.51 img/s (§4)
+  googlenet_infer    GoogleNet inference bs16, vs 600.94 img/s (§4)
+  vgg19_infer        VGG-19 inference bs16, vs 96.75 img/s (§4)
+  lstm               2xLSTM+fc h512 bs64 seqlen100 IMDB config, ms/batch
+                     vs 184 ms/batch (K40m, §3; benchmark/paddle/rnn/rnn.py)
+  attention          flash-attention (Pallas, fwd+bwd) vs XLA einsum
+                     attention at T=4096 causal — the long-context kernel
+                     the 2018 reference has no counterpart for;
+                     vs_baseline is the speedup over the XLA path
+  transformer        transformer-LM train step with use_flash attention
+                     (models/transformer.py), tokens/sec + MFU
 """
 
 import json
@@ -21,22 +31,13 @@ import time
 
 import numpy as np
 
-BASELINE_IMG_S = 81.69
-# Batch sweep on the tunneled v5e (pure-JAX ceiling probe, tools/
-# jax_resnet_ref.py, r3): bs256 2573 img/s / bs384 2544 / bs512 2508 /
-# bs640 2389 / bs768 2322 / bs1024 135 (host-spill collapse). Smaller
-# batches win: per-step HBM pressure drops and the step stays wholly
-# resident. bs256 is the throughput-optimal point.
-BATCH = int(os.environ.get("BENCH_BATCH", "256"))
+BATCH = os.environ.get("BENCH_BATCH")
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 # the tunneled TPU terminal runs the first ~20 executions of a fresh
 # executable slow (program caching); warm past that to measure steady state
 WARMUP = int(os.environ.get("BENCH_WARMUP", "25"))
 AMP = os.environ.get("BENCH_AMP", "1") == "1"
 AMP_LEVEL = os.environ.get("BENCH_AMP_LEVEL", "O2")
-# ResNet-50 @224: ~4.09 GFLOP forward per image (counting FMA as 2 FLOPs);
-# a training step costs ~3x forward (fwd + input grad + weight grad).
-TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
 # per-chip bf16 peak for MFU reporting (v5e ~197 TF/s, v4 ~275, v5p ~459);
 # override with BENCH_PEAK_TFLOPS for other chips. NOTE (r3 measured): the
 # tunneled chip in this environment sustains ~32 TF/s bf16 on pure in-graph
@@ -46,6 +47,147 @@ TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
 # so MFU against the nominal 197 TF/s peak tops out near 0.16 here
 # regardless of program quality.
 PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+
+# Per-family config. flops = forward GFLOPs/image at 224x224 (mul+add as 2);
+# training step ~ 3x forward (fwd + input grad + weight grad). Baselines are
+# the reference's best published number for the family (BASELINE.md §4;
+# img/s, higher is better). train_bs: batch sweep on the tunneled v5e found
+# bs256 throughput-optimal for ResNet-50 (r3, tools/jax_resnet_ref.py);
+# VGG-19's larger activations favor a smaller batch.
+CNN = {
+    "resnet": dict(builder="resnet50", fwd_flops=4.09e9, train_bs=256,
+                   train_base=81.69, infer_base=217.69, lr=0.1),
+    # nets without batch norm diverge to NaN at lr=0.1 within the warmup
+    # steps (the assert on the final loss is the guard); throughput is
+    # lr-independent, so run them at a stable rate
+    "alexnet": dict(builder="alexnet", fwd_flops=1.43e9, train_bs=256,
+                    train_base=626.53, infer_base=850.51, lr=0.01),
+    "googlenet": dict(builder="googlenet", fwd_flops=3.0e9, train_bs=256,
+                      train_base=250.46, infer_base=600.94, lr=0.005),
+    "vgg19": dict(builder="vgg19", fwd_flops=39.0e9, train_bs=128,
+                  train_base=28.46, infer_base=96.75, lr=0.005),
+}
+INFER_BS = 16  # the reference's §4 inference batch
+
+
+def _feeds(exe, batch, shapes_dtypes, rng):
+    """Rotating pre-staged HBM batches through the DoubleBufferedFeeder
+    (reader/pipeline.py; reference create_double_buffer_reader_op.cc).
+    Pre-staged by default: on this tunneled single-chip environment
+    host->HBM bandwidth collapses to ~70 MB/s while the chip computes
+    (measured r2; 1.4 GB/s idle), so per-step host uploads would benchmark
+    the tunnel, not the chip. BENCH_HOST_PIPELINE=1 switches to true
+    per-step host uploads for real TPU hosts; the overlap path itself is
+    correctness-tested in tests/test_input_pipeline.py."""
+    import jax
+    from paddle_tpu.reader.pipeline import DoubleBufferedFeeder
+
+    host_uploads = os.environ.get("BENCH_HOST_PIPELINE", "0") == "1"
+    n_bufs = 3 if host_uploads else 2
+
+    def make_batch():
+        out = {}
+        for name, shape, dtype in shapes_dtypes:
+            if dtype == "img":
+                out[name] = rng.standard_normal((batch,) + shape,
+                                                dtype=np.float32)
+            else:
+                out[name] = rng.integers(0, dtype, (batch,) + shape,
+                                         ).astype(np.int32)
+        return out
+
+    host = [make_batch() for _ in range(n_bufs)]
+    if not host_uploads:
+        host = [{k: jax.device_put(v, exe.device) for k, v in b.items()}
+                for b in host]
+
+    def reader():
+        i = 0
+        while True:
+            yield host[i % len(host)]
+            i += 1
+
+    return iter(DoubleBufferedFeeder(
+        reader, device=exe.device if host_uploads else None, capacity=1))
+
+
+def _timed_loop(run_step, warmup, steps):
+    """Warm, then time `steps` back-to-back enqueues with one final sync.
+    run_step() must return an on-device scalar (return_numpy=False)."""
+    for _ in range(max(warmup, 1)):
+        out = run_step()
+    float(np.asarray(out).ravel()[0])  # sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = run_step()
+    final = float(np.asarray(out).ravel()[0])  # sync on the last step
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final)
+    return dt
+
+
+def main_cnn(family, train=True):
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    cfg = CNN[family]
+    builder = getattr(models, cfg["builder"])
+    batch = int(BATCH) if BATCH else (cfg["train_bs"] if train else INFER_BS)
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data(name="img", shape=[3, 224, 224],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        if train:
+            avg_cost, _, _ = models.build_image_classifier(
+                builder, img, label, class_dim=1000)
+            opt = fluid.optimizer.Momentum(learning_rate=cfg["lr"],
+                                           momentum=0.9)
+            if AMP:
+                # bf16 matmul/conv compute on the MXU, fp32 master weights;
+                # O2 keeps activations bf16 end-to-end (halves HBM traffic)
+                opt = fluid.amp.decorate(opt, level=AMP_LEVEL)
+            opt.minimize(avg_cost, startup_program=startup)
+            fetch = avg_cost
+        else:
+            logits = builder(img, class_dim=1000, is_test=True)
+            predict = fluid.layers.softmax(logits)
+            # a scalar fetch keeps the timed loop sync-free; argmax-sum is
+            # data-dependent so XLA cannot dead-code the network
+            fetch = fluid.layers.reduce_sum(
+                fluid.layers.reduce_max(predict, dim=-1))
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+
+    rng = np.random.default_rng(0)
+    shapes = [("img", (3, 224, 224), "img")]
+    if train:
+        shapes.append(("label", (1,), 1000))   # infer programs take no label
+    feeds = _feeds(exe, batch, shapes, rng)
+
+    def step():
+        out, = exe.run(main_prog, feed=next(feeds), fetch_list=[fetch],
+                       return_numpy=False)
+        return out
+
+    dt = _timed_loop(step, WARMUP, STEPS)
+    img_s = batch * STEPS / dt
+    flops_per_img = (3 if train else 1) * cfg["fwd_flops"]
+    mfu = img_s * flops_per_img / (PEAK_TFLOPS * 1e12)
+    base = cfg["train_base"] if train else cfg["infer_base"]
+    job = "train" if train else "infer"
+    print(json.dumps({
+        "metric": f"{cfg['builder']}_{job}_images_per_sec",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / base, 3),
+        "batch": batch,
+        "amp": AMP if train else False,
+        "amp_level": (AMP_LEVEL if AMP else None) if train else None,
+        "mfu": round(mfu, 4),
+    }))
 
 
 def main_lstm():
@@ -59,8 +201,7 @@ def main_lstm():
                                                          "512"))
     bsz = int(os.environ.get("BENCH_LSTM_BATCH", "64"))
     seqlen = 100
-    steps = int(os.environ.get("BENCH_STEPS", "30"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "25"))
+    steps, warmup = STEPS, WARMUP
     baseline_ms = 184.0   # K40m, BASELINE.md §3
 
     main_prog, startup = fluid.Program(), fluid.Program()
@@ -92,18 +233,12 @@ def main_lstm():
     feed = {"words": jax.device_put(ids, exe.device),
             "label": jax.device_put(labs, exe.device)}
 
-    for _ in range(max(warmup, 1)):
+    def step():
         loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
                         return_numpy=False)
-    float(np.asarray(loss).ravel()[0])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, = exe.run(main_prog, feed=feed, fetch_list=[avg_cost],
-                        return_numpy=False)
-    final_loss = float(np.asarray(loss).ravel()[0])
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss)
+        return loss
 
+    dt = _timed_loop(step, warmup, steps)
     ms_batch = dt / steps * 1000
     # fwd FLOPs/batch: input projections (emb->4H, H->4H) + recurrent gemm
     # (H->4H per step) for both layers; train step ~ 3x forward
@@ -121,96 +256,149 @@ def main_lstm():
     }))
 
 
-def main():
-    import paddle_tpu as fluid
-    from paddle_tpu import models
-
-    main_prog, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main_prog, startup):
-        img = fluid.layers.data(name="img", shape=[3, 224, 224],
-                                dtype="float32")
-        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
-        avg_cost, _, _ = models.build_image_classifier(
-            models.resnet50, img, label, class_dim=1000)
-        opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
-        if AMP:
-            # bf16 matmul/conv compute on the MXU, fp32 master weights;
-            # O2 keeps activations bf16 end-to-end (halves HBM traffic)
-            opt = fluid.amp.decorate(opt, level=AMP_LEVEL)
-        opt.minimize(avg_cost, startup_program=startup)
-
-    exe = fluid.Executor(fluid.TPUPlace(0))
-    exe.run(startup)
-
-    rng = np.random.default_rng(0)
+def main_attention():
+    """Pallas flash attention (fwd+bwd, O(T) memory) vs the XLA einsum
+    reference at T=4096 causal — the kernel behind fused_attention
+    (use_flash=True) and the in-shard blocks of ring attention. The 2018
+    reference has no attention op at all (SURVEY.md §2.5 last row), so
+    vs_baseline is the measured speedup over the XLA attention path on the
+    same chip: >1 means the Pallas kernels beat the compiler."""
     import jax
-    if os.environ.get("BENCH_STAGED", "0") == "1":
-        # stage one batch in HBM (compute-only throughput, the old mode)
-        x = rng.standard_normal((BATCH, 3, 224, 224), dtype=np.float32)
-        y = rng.integers(0, 1000, (BATCH, 1)).astype(np.int64)
-        feed = {"img": jax.device_put(x, exe.device),
-                "label": jax.device_put(y, exe.device)}
-        feeds = iter(lambda: feed, None)
-    else:
-        # input pipeline: batches flow through the DoubleBufferedFeeder
-        # (reader/pipeline.py; reference create_double_buffer_reader_op.cc).
-        # By default the rotating batches are pre-staged in HBM once: on this
-        # tunneled single-chip environment host->HBM bandwidth collapses to
-        # ~70 MB/s while the chip computes (measured; 1.4 GB/s idle), so
-        # per-step host uploads would benchmark the tunnel, not the chip.
-        # BENCH_HOST_PIPELINE=1 switches to true per-step host uploads for
-        # real TPU hosts; the overlap path itself is correctness-tested in
-        # tests/test_input_pipeline.py.
-        from paddle_tpu.reader.pipeline import DoubleBufferedFeeder
-        host_uploads = os.environ.get("BENCH_HOST_PIPELINE", "0") == "1"
-        n_bufs = 3 if host_uploads else 2
-        host = [(rng.standard_normal((BATCH, 3, 224, 224), dtype=np.float32),
-                 rng.integers(0, 1000, (BATCH, 1)).astype(np.int32))
-                for _ in range(n_bufs)]
-        if not host_uploads:
-            host = [(jax.device_put(x, exe.device),
-                     jax.device_put(y, exe.device)) for x, y in host]
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_attention import flash_attention
+    from paddle_tpu.parallel.ring_attention import attention_reference
 
-        def reader():
-            i = 0
-            while True:
-                x, y = host[i % len(host)]
-                yield {"img": x, "label": y}
-                i += 1
+    b = int(os.environ.get("BENCH_ATTN_BATCH", "1"))
+    t = int(os.environ.get("BENCH_ATTN_SEQLEN", "4096"))
+    h, d = 8, 64
+    steps, warmup = STEPS, WARMUP
+    rng = np.random.default_rng(1)
+    q, k, v = [jax.device_put(rng.standard_normal((b, t, h, d))
+                              .astype(np.float32)) for _ in range(3)]
 
-        feeds = iter(DoubleBufferedFeeder(
-            reader, device=exe.device if host_uploads else None, capacity=1))
+    def make(fn):
+        return jax.jit(jax.grad(
+            lambda a, bb, c: jnp.sum(fn(a, bb, c) ** 2), argnums=(0, 1, 2)))
 
-    for _ in range(max(WARMUP, 1)):
-        loss, = exe.run(main_prog, feed=next(feeds), fetch_list=[avg_cost],
-                        return_numpy=False)
-    float(np.asarray(loss).ravel()[0])  # sync
+    def time_once(g, n):
+        # fetch a scalar from the result for the sync: on the tunneled
+        # terminal block_until_ready returns before execution completes
+        # (measured r3), so only a value readback is a trustworthy fence
+        r = g(q, k, v)
+        float(np.asarray(r[0]).ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = g(q, k, v)
+        float(np.asarray(r[0]).ravel()[0])
+        return (time.perf_counter() - t0) / n
 
-    # return_numpy=False keeps the fetched loss on-device: steps enqueue
-    # back to back with no per-step host sync; one sync at the end.
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        loss, = exe.run(main_prog, feed=next(feeds), fetch_list=[avg_cost],
-                        return_numpy=False)
-    final_loss = float(np.asarray(loss).ravel()[0])  # sync on the last step
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss)
-
-    img_s = BATCH * STEPS / dt
-    mfu = img_s * TRAIN_FLOPS_PER_IMG / (PEAK_TFLOPS * 1e12)
+    g_flash = make(lambda a, bb, c: flash_attention(a, bb, c, True))
+    g_xla = make(lambda a, bb, c: attention_reference(a, bb, c, causal=True))
+    for g in (g_flash, g_xla):          # warm past the program cache
+        for _ in range(warmup):
+            r = g(q, k, v)
+        float(np.asarray(r[0]).ravel()[0])
+    # the tunneled chip drifts run-to-run (r3: high variance); alternate
+    # measurement rounds and take each side's best so drift hits both
+    flash_ts, xla_ts = [], []
+    for _ in range(3):
+        flash_ts.append(time_once(g_flash, steps))
+        xla_ts.append(time_once(g_xla, steps))
+    flash_s, xla_s = min(flash_ts), min(xla_ts)
     print(json.dumps({
-        "metric": "resnet50_train_images_per_sec",
-        "value": round(img_s, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-        "batch": BATCH,
-        "amp": AMP,
-        "amp_level": AMP_LEVEL if AMP else None,
-        "mfu": round(mfu, 4),
+        "metric": f"flash_attention_fwd_bwd_ms_T{t}_causal",
+        "value": round(flash_s * 1e3, 3),
+        "unit": "ms/step",
+        "vs_baseline": round(xla_s / flash_s, 3),
+        "xla_reference_ms": round(xla_s * 1e3, 3),
+        "shape": [b, t, h, d],
     }))
 
 
+def main_transformer():
+    """Transformer-LM training step (models/transformer.py) with flash
+    attention: tokens/sec + MFU. No reference counterpart (2018);
+    vs_baseline is the ratio against the same model on the XLA einsum
+    attention path (use_flash=False). Measured honestly: the standalone
+    flash kernels beat the einsum (1.5-1.6x fwd+bwd at these shapes) but
+    inside the whole-program jit the pallas custom call is a fusion
+    barrier, so end-to-end the einsum path wins at benchmark sizes —
+    flash's end-to-end value is MEMORY (O(T) residuals; T=16k+ trains
+    where the einsum path's [T,T] residuals cannot)."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    bsz = int(BATCH) if BATCH else 8
+    seqlen = int(os.environ.get("BENCH_SEQLEN", "2048"))
+    n_layer = int(os.environ.get("BENCH_LAYERS", "4"))
+    d_model = int(os.environ.get("BENCH_DMODEL", "512"))
+    n_head = d_model // 64
+    vocab = 8192
+    steps, warmup = STEPS, WARMUP
+
+    def build_and_time(use_flash):
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            tok = fluid.layers.data(name="tok", shape=[-1, seqlen],
+                                    dtype="int64", append_batch_size=False)
+            lab = fluid.layers.data(name="lab", shape=[-1, seqlen],
+                                    dtype="int64", append_batch_size=False)
+            loss = models.transformer_lm(
+                tok, lab, vocab_size=vocab, d_model=d_model,
+                n_head=n_head, n_layer=n_layer, use_flash=use_flash)
+            opt = fluid.optimizer.Adam(learning_rate=1e-4)
+            if AMP:
+                opt = fluid.amp.decorate(opt, level=AMP_LEVEL)
+            opt.minimize(loss, startup_program=startup)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, vocab, (bsz, seqlen)).astype(np.int32)
+        labs = rng.integers(0, vocab, (bsz, seqlen)).astype(np.int32)
+        feed = {"tok": jax.device_put(ids, exe.device),
+                "lab": jax.device_put(labs, exe.device)}
+
+        def step():
+            out, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                           return_numpy=False)
+            return out
+
+        return _timed_loop(step, warmup, steps)
+
+    dt = build_and_time(True)
+    dt_xla = build_and_time(False)
+    tok_s = bsz * seqlen * steps / dt
+    # fwd FLOPs/token: 2*(attn qkvo 4*d^2 + mlp 8*d^2) + attention scores
+    # 2*2*T*d per token; train ~ 3x fwd
+    flops_tok = n_layer * (2 * 12 * d_model ** 2
+                           + 4 * seqlen * d_model) + 2 * vocab * d_model
+    mfu = 3 * tok_s * flops_tok / (PEAK_TFLOPS * 1e12)
+    print(json.dumps({
+        "metric": "transformer_lm_train_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(dt_xla / dt, 3),
+        "xla_attention_tokens_per_sec": round(bsz * seqlen * steps / dt_xla,
+                                              1),
+        "batch": bsz, "seqlen": seqlen, "layers": n_layer,
+        "d_model": d_model, "amp": AMP, "mfu": round(mfu, 4),
+    }))
+
+
+def main():
+    mode = os.environ.get("BENCH_MODE", "resnet")
+    if mode == "lstm":
+        return main_lstm()
+    if mode == "attention":
+        return main_attention()
+    if mode == "transformer":
+        return main_transformer()
+    family, _, job = mode.partition("_")
+    if family not in CNN or job not in ("", "infer"):
+        raise SystemExit(f"unknown BENCH_MODE={mode}")
+    return main_cnn(family, train=(job != "infer"))
+
+
 if __name__ == "__main__":
-    if os.environ.get("BENCH_MODE", "resnet") == "lstm":
-        sys.exit(main_lstm())
     sys.exit(main())
