@@ -1,11 +1,18 @@
-/* Generic C inference driver: load any single-float-input model saved by
+/* Generic C inference driver: load any model saved by
  * fluid.io.save_inference_model and run one forward pass (reference:
- * paddle/capi/examples/model_inference/dense/main.c generalized — the
- * conv and sequence book models go through this same path).
+ * paddle/capi/examples/model_inference/{dense,sparse_binary,multi_thread}
+ * generalized — every book chapter's saved artifact goes through this
+ * path, the way the reference's C++ book inference tests do:
+ * paddle/fluid/inference/tests/book/test_inference_fit_a_line.cc + 7
+ * siblings).
  *
- * Usage: infer_generic <model_dir> <input_name> d0 d1 [d2 [d3]]
- * The input tensor is filled with the deterministic pattern
- * x[i] = sin(0.01 * i) so the Python side can reproduce it exactly.
+ * Usage: infer_generic <model_dir> <input_spec>...
+ * input_spec := name:dtype:d0xd1[xd2[xd3]][:mod=M][:lod=o0,o1,...]
+ *   dtype = f32 | i64 | i32
+ *   f32 fill pattern: x[i] = sin(0.01*i + slot)        (slot = spec index)
+ *   int fill pattern: x[i] = (7*i + 3*slot) % M        (mod=M required)
+ *   lod   = level-1 sequence start offsets (sequence inputs)
+ * The Python side reproduces the same patterns to compare outputs.
  *
  * Build:
  *   gcc infer_generic.c -I paddle_tpu/native -L paddle_tpu/native \
@@ -14,6 +21,7 @@
 #include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 
 #include "capi.h"
 
@@ -26,48 +34,118 @@
     }                                                        \
   } while (0)
 
+static int stage_input(paddle_tpu_machine machine, char* spec, int slot) {
+  /* tokenize name:dtype:dims[:mod=M][:lod=...] */
+  char* save = NULL;
+  char* name = strtok_r(spec, ":", &save);
+  char* dtype_s = strtok_r(NULL, ":", &save);
+  char* dims_s = strtok_r(NULL, ":", &save);
+  if (!name || !dtype_s || !dims_s) {
+    fprintf(stderr, "bad input spec (need name:dtype:dims)\n");
+    return 1;
+  }
+  long long mod = 0;
+  char* lod_s = NULL;
+  char* extra;
+  while ((extra = strtok_r(NULL, ":", &save)) != NULL) {
+    if (strncmp(extra, "mod=", 4) == 0) mod = atoll(extra + 4);
+    else if (strncmp(extra, "lod=", 4) == 0) lod_s = extra + 4;
+  }
+
+  int64_t dims[4];
+  int ndim = 0;
+  int64_t numel = 1;
+  char* dsave = NULL;
+  for (char* d = strtok_r(dims_s, "x", &dsave); d && ndim < 4;
+       d = strtok_r(NULL, "x", &dsave)) {
+    dims[ndim] = atoll(d);
+    numel *= dims[ndim];
+    ndim++;
+  }
+
+  paddle_tpu_dtype dt;
+  if (strcmp(dtype_s, "f32") == 0) dt = PD_DTYPE_FLOAT32;
+  else if (strcmp(dtype_s, "i64") == 0) dt = PD_DTYPE_INT64;
+  else if (strcmp(dtype_s, "i32") == 0) dt = PD_DTYPE_INT32;
+  else {
+    fprintf(stderr, "bad dtype %s\n", dtype_s);
+    return 1;
+  }
+
+  if (dt == PD_DTYPE_FLOAT32) {
+    float* x = (float*)malloc(sizeof(float) * (size_t)numel);
+    for (int64_t i = 0; i < numel; ++i)
+      x[i] = (float)sin(0.01 * (double)i + (double)slot);
+    CHECK(paddle_tpu_machine_set_input_typed(machine, name, x, dt, dims,
+                                             ndim));
+    free(x);
+  } else {
+    if (mod <= 0) {
+      fprintf(stderr, "int input %s needs mod=M\n", name);
+      return 1;
+    }
+    if (dt == PD_DTYPE_INT64) {
+      int64_t* x = (int64_t*)malloc(sizeof(int64_t) * (size_t)numel);
+      for (int64_t i = 0; i < numel; ++i) x[i] = (7 * i + 3 * slot) % mod;
+      CHECK(paddle_tpu_machine_set_input_typed(machine, name, x, dt, dims,
+                                               ndim));
+      free(x);
+    } else {
+      int32_t* x = (int32_t*)malloc(sizeof(int32_t) * (size_t)numel);
+      for (int64_t i = 0; i < numel; ++i)
+        x[i] = (int32_t)((7 * i + 3 * slot) % mod);
+      CHECK(paddle_tpu_machine_set_input_typed(machine, name, x, dt, dims,
+                                               ndim));
+      free(x);
+    }
+  }
+
+  if (lod_s != NULL) {
+    int64_t offs[64];
+    int n = 0;
+    char* lsave = NULL;
+    for (char* o = strtok_r(lod_s, ",", &lsave); o && n < 64;
+         o = strtok_r(NULL, ",", &lsave))
+      offs[n++] = atoll(o);
+    CHECK(paddle_tpu_machine_set_input_lod(machine, name, offs, n));
+  }
+  return 0;
+}
+
 int main(int argc, char** argv) {
-  if (argc < 5) {
-    fprintf(stderr, "usage: %s <model_dir> <input_name> d0 d1 [d2 [d3]]\n",
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: %s <model_dir> name:dtype:d0xd1[:mod=M][:lod=..] ...\n",
             argv[0]);
     return 2;
-  }
-  int ndim_in = argc - 3;
-  if (ndim_in > 4) ndim_in = 4;
-  int64_t dims[4];
-  int64_t numel = 1;
-  int d;
-  for (d = 0; d < ndim_in; ++d) {
-    dims[d] = atoll(argv[3 + d]);
-    numel *= dims[d];
   }
 
   CHECK(paddle_tpu_init());
   paddle_tpu_machine machine;
   CHECK(paddle_tpu_machine_create(&machine, argv[1]));
 
-  float* x = (float*)malloc(sizeof(float) * (size_t)numel);
-  int64_t i;
-  for (i = 0; i < numel; ++i) x[i] = (float)sin(0.01 * (double)i);
-  CHECK(paddle_tpu_machine_set_input(machine, argv[2], x, dims, ndim_in));
-  free(x);
+  for (int a = 2; a < argc; ++a)
+    if (stage_input(machine, argv[a], a - 2) != 0) return 1;
 
   CHECK(paddle_tpu_machine_forward(machine));
 
   int count = 0;
   CHECK(paddle_tpu_machine_output_count(machine, &count));
-  const float* out;
-  const int64_t* out_dims;
-  int ndim;
-  CHECK(paddle_tpu_machine_get_output(machine, 0, &out, &out_dims, &ndim));
-  int64_t total = 1;
-  printf("outputs=%d ndim=%d shape=[", count, ndim);
-  for (d = 0; d < ndim; ++d) {
-    total *= out_dims[d];
-    printf(d ? ",%lld" : "%lld", (long long)out_dims[d]);
+  for (int o = 0; o < count; ++o) {
+    const float* out;
+    const int64_t* out_dims;
+    int ndim;
+    CHECK(paddle_tpu_machine_get_output(machine, o, &out, &out_dims, &ndim));
+    int64_t total = 1;
+    printf("output %d ndim=%d shape=[", o, ndim);
+    for (int d = 0; d < ndim; ++d) {
+      total *= out_dims[d];
+      printf(d ? ",%lld" : "%lld", (long long)out_dims[d]);
+    }
+    printf("]\n");
+    for (int64_t i = 0; i < total; ++i)
+      printf("out%d[%lld]=%.6f\n", o, (long long)i, out[i]);
   }
-  printf("]\n");
-  for (i = 0; i < total; ++i) printf("out[%lld]=%.6f\n", (long long)i, out[i]);
 
   CHECK(paddle_tpu_machine_destroy(machine));
   return 0;

@@ -1,15 +1,20 @@
 """Python side of the C inference API (native/capi.cc embeds CPython and
 drives this module; reference: paddle/capi/gradient_machine.h fronted the
-C++ GradientMachine the same way).
+C++ GradientMachine the same way, with paddle_arguments carrying value
+matrices, integer id vectors, and sequence_start_positions).
 
 Machine wraps load_inference_model + a private scope; inputs arrive as raw
-float32 bytes + dims from C, outputs go back the same way."""
+bytes + dims + dtype tag from C (0=f32, 1=i64, 2=i32 — capi.h
+paddle_tpu_dtype), optional level-1 LoD offsets attach per input, outputs
+go back as float32 bytes."""
 
 from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
 import numpy as np
+
+_DTYPES = {0: np.float32, 1: np.int64, 2: np.int32}
 
 
 class Machine:
@@ -26,21 +31,42 @@ class Machine:
              self._fetch_targets) = fluid.io.load_inference_model(
                 model_dir, self._exe)
         self._inputs: Dict[str, np.ndarray] = {}
+        self._lods: Dict[str, list] = {}
 
-    def set_input(self, name: str, payload: bytes, dims: Tuple[int, ...]):
+    def set_input(self, name: str, payload: bytes, dims: Tuple[int, ...],
+                  dtype: int = 0):
         if name not in self._feed_names:
             raise KeyError(
                 f"'{name}' is not a feed of this model; feeds: "
                 f"{self._feed_names}")
-        arr = np.frombuffer(payload, dtype=np.float32).reshape(dims).copy()
+        np_dtype = _DTYPES[int(dtype)]
+        arr = np.frombuffer(payload, dtype=np_dtype).reshape(dims).copy()
         self._inputs[name] = arr
+        self._lods.pop(name, None)
+
+    def set_input_lod(self, name: str, offsets: Tuple[int, ...]):
+        if name not in self._inputs:
+            raise KeyError(f"set_input must stage '{name}' before its LoD")
+        offs = [int(o) for o in offsets]
+        rows = self._inputs[name].shape[0]
+        if offs[-1] != rows:
+            raise ValueError(
+                f"LoD offsets end at {offs[-1]} but '{name}' has {rows} "
+                "rows (offsets are sequence_start_positions over axis 0)")
+        self._lods[name] = offs
 
     def forward(self) -> List[Tuple[bytes, Tuple[int, ...]]]:
         missing = [n for n in self._feed_names if n not in self._inputs]
         if missing:
             raise ValueError(f"missing inputs: {missing}")
+        feed = {}
+        for n, arr in self._inputs.items():
+            if n in self._lods:
+                feed[n] = self._executor_mod.LoDTensor(arr, [self._lods[n]])
+            else:
+                feed[n] = arr
         with self._executor_mod.scope_guard(self._scope):
-            outs = self._exe.run(self._program, feed=dict(self._inputs),
+            outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=self._fetch_targets)
         result = []
         for o in outs:

@@ -90,15 +90,31 @@ paddle_error paddle_tpu_machine_create(paddle_tpu_machine* machine,
   return PD_NO_ERROR;
 }
 
-paddle_error paddle_tpu_machine_set_input(paddle_tpu_machine machine,
-                                          const char* name,
-                                          const float* data,
-                                          const int64_t* dims, int ndim) {
+paddle_error paddle_tpu_machine_set_input_typed(paddle_tpu_machine machine,
+                                                const char* name,
+                                                const void* data,
+                                                paddle_tpu_dtype dtype,
+                                                const int64_t* dims,
+                                                int ndim) {
   if (machine == nullptr || name == nullptr || data == nullptr ||
       dims == nullptr)
     return PD_NULLPTR;
   Machine* m = static_cast<Machine*>(machine);
   if (ndim < 0) return PD_OUT_OF_RANGE;
+  int64_t elem_size;
+  switch (dtype) {
+    case PD_DTYPE_FLOAT32:
+      elem_size = sizeof(float);
+      break;
+    case PD_DTYPE_INT64:
+      elem_size = sizeof(int64_t);
+      break;
+    case PD_DTYPE_INT32:
+      elem_size = sizeof(int32_t);
+      break;
+    default:
+      return PD_NOT_SUPPORTED;
+  }
   int64_t numel = 1;
   for (int i = 0; i < ndim; ++i) {
     if (dims[i] < 0) return PD_OUT_OF_RANGE;
@@ -107,19 +123,52 @@ paddle_error paddle_tpu_machine_set_input(paddle_tpu_machine machine,
       return PD_OUT_OF_RANGE;  // numel overflow
     numel *= dims[i];
   }
-  if (numel > std::numeric_limits<int64_t>::max() /
-                  static_cast<int64_t>(sizeof(float)))
+  if (numel > std::numeric_limits<int64_t>::max() / elem_size)
     return PD_OUT_OF_RANGE;  // byte-size overflow
   Gil gil;
   PyObject* dims_tuple = PyTuple_New(ndim);
   for (int i = 0; i < ndim; ++i)
     PyTuple_SET_ITEM(dims_tuple, i, PyLong_FromLongLong(dims[i]));
   PyObject* payload = PyBytes_FromStringAndSize(
-      reinterpret_cast<const char*>(data), numel * sizeof(float));
-  PyObject* r = PyObject_CallMethod(m->py_machine, "set_input", "sOO", name,
-                                    payload, dims_tuple);
+      reinterpret_cast<const char*>(data), numel * elem_size);
+  PyObject* r =
+      PyObject_CallMethod(m->py_machine, "set_input", "sOOi", name, payload,
+                          dims_tuple, static_cast<int>(dtype));
   Py_DECREF(payload);
   Py_DECREF(dims_tuple);
+  if (r == nullptr) {
+    PyErr_Print();
+    return PD_OUT_OF_RANGE;
+  }
+  Py_DECREF(r);
+  return PD_NO_ERROR;
+}
+
+paddle_error paddle_tpu_machine_set_input(paddle_tpu_machine machine,
+                                          const char* name,
+                                          const float* data,
+                                          const int64_t* dims, int ndim) {
+  return paddle_tpu_machine_set_input_typed(machine, name, data,
+                                            PD_DTYPE_FLOAT32, dims, ndim);
+}
+
+paddle_error paddle_tpu_machine_set_input_lod(paddle_tpu_machine machine,
+                                              const char* name,
+                                              const int64_t* offsets,
+                                              int n) {
+  if (machine == nullptr || name == nullptr || offsets == nullptr)
+    return PD_NULLPTR;
+  if (n < 2 || offsets[0] != 0) return PD_OUT_OF_RANGE;
+  for (int i = 1; i < n; ++i)
+    if (offsets[i] < offsets[i - 1]) return PD_OUT_OF_RANGE;
+  Machine* m = static_cast<Machine*>(machine);
+  Gil gil;
+  PyObject* offs = PyTuple_New(n);
+  for (int i = 0; i < n; ++i)
+    PyTuple_SET_ITEM(offs, i, PyLong_FromLongLong(offsets[i]));
+  PyObject* r = PyObject_CallMethod(m->py_machine, "set_input_lod", "sO",
+                                    name, offs);
+  Py_DECREF(offs);
   if (r == nullptr) {
     PyErr_Print();
     return PD_OUT_OF_RANGE;

@@ -46,6 +46,31 @@ paddle_error paddle_tpu_machine_set_input(paddle_tpu_machine machine,
                                           const float* data,
                                           const int64_t* dims, int ndim);
 
+/* Element types for typed inputs (reference paddle_arguments carried both
+ * value matrices and integer id vectors — capi/arguments.h). */
+typedef enum {
+  PD_DTYPE_FLOAT32 = 0,
+  PD_DTYPE_INT64 = 1,
+  PD_DTYPE_INT32 = 2,
+} paddle_tpu_dtype;
+
+/* Stage one named input of any supported dtype (row-major). */
+paddle_error paddle_tpu_machine_set_input_typed(paddle_tpu_machine machine,
+                                                const char* name,
+                                                const void* data,
+                                                paddle_tpu_dtype dtype,
+                                                const int64_t* dims,
+                                                int ndim);
+
+/* Attach level-1 LoD offsets to a previously staged input: `offsets` is
+ * the reference's sequence_start_positions vector (n monotonically
+ * increasing values starting at 0, last == rows of the staged tensor —
+ * reference capi/arguments.h paddle_arguments_set_sequence_start_pos).
+ * Call after set_input[_typed] for sequence (LoD) feeds. */
+paddle_error paddle_tpu_machine_set_input_lod(paddle_tpu_machine machine,
+                                              const char* name,
+                                              const int64_t* offsets, int n);
+
 /* Run the forward pass over the staged inputs
  * (reference gradient_machine.h:73 forward, isTrain=false). */
 paddle_error paddle_tpu_machine_forward(paddle_tpu_machine machine);
