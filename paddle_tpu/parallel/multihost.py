@@ -24,7 +24,8 @@ import tempfile
 from typing import Callable, Iterable, Optional
 
 __all__ = ["initialize", "shard_reader", "CheckpointableReader",
-           "save_checkpoint", "load_checkpoint", "latest_checkpoint"]
+           "save_checkpoint", "load_checkpoint", "latest_checkpoint",
+           "is_save_leader"]
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -128,9 +129,18 @@ class CheckpointableReader:
 _META = "checkpoint_meta.json"
 
 
+def is_save_leader() -> bool:
+    """True on the one process elected to write checkpoints. The reference
+    elects ONE trainer to save (go/master/service.go:481 RequestSaveModel);
+    under SPMD every process holds identical (or completing) param state,
+    so process 0 is the natural lease-free leader."""
+    import jax
+    return jax.process_index() == 0
+
+
 def save_checkpoint(executor, dirname: str, step: int, main_program=None,
                     extra_meta: Optional[dict] = None, reader=None,
-                    reader_in_flight: int = 0):
+                    reader_in_flight: int = 0, leader_only: bool = True):
     """Persistables + step metadata, written atomically (temp file + rename)
     so a crash mid-write never corrupts the latest checkpoint — the
     md5+meta discipline of the Go pserver checkpoints
@@ -138,7 +148,29 @@ def save_checkpoint(executor, dirname: str, step: int, main_program=None,
     `reader` to capture the data-stream position too (mid-pass resume);
     `reader_in_flight` = number of samples sitting in prefetch buffers
     between the reader and the training step (they get re-read on
-    restart rather than lost)."""
+    restart rather than lost).
+
+    In multi-process SPMD only the elected leader writes the params + meta
+    (reference RequestSaveModel, go/master/service.go:481: every process
+    would otherwise race on the same directory) — but each process's
+    reader position is process-local state, so EVERY process persists its
+    own into a distinct per-process file (no race) that load_checkpoint
+    restores by process index. Returns True when this process wrote the
+    main checkpoint. leader_only=False restores the old
+    every-process-writes behavior for process-local dirnames."""
+    import jax
+    os.makedirs(dirname, exist_ok=True)
+    if reader is not None:
+        # per-process reader position: distinct filename per process, so
+        # non-leaders persist their shard's stream position too
+        rstate = reader.state(in_flight=reader_in_flight)
+        fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".rdr.tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump({"step": step, **rstate}, f)
+        os.replace(tmp, os.path.join(
+            dirname, _reader_state_file(jax.process_index())))
+    if leader_only and not is_save_leader():
+        return False
     from .. import io as io_mod
     ckpt_dir = os.path.join(dirname, f"step_{step}")
     os.makedirs(ckpt_dir, exist_ok=True)
@@ -150,6 +182,11 @@ def save_checkpoint(executor, dirname: str, step: int, main_program=None,
     with os.fdopen(fd, "w") as f:
         json.dump(meta, f)
     os.replace(tmp, os.path.join(dirname, _META))
+    return True
+
+
+def _reader_state_file(process_index: int) -> str:
+    return f"reader_state_p{process_index}.json"
 
 
 def latest_checkpoint(dirname: str) -> Optional[dict]:
@@ -169,7 +206,10 @@ def load_checkpoint(executor, dirname: str, main_program=None,
     (with 'step') or None when no checkpoint exists — the trainer resumes
     at meta['step'] + 1 (master recover parity, go/master/service.go:166).
     With `reader` (a CheckpointableReader), the data-stream position is
-    restored too, so the resumed pass continues exactly where it stopped."""
+    restored too — from THIS process's per-process state file when present
+    (multi-process runs: each shard's position is its own), falling back
+    to the leader-written meta fields."""
+    import jax
     from .. import io as io_mod
     meta = latest_checkpoint(dirname)
     if meta is None:
@@ -177,5 +217,23 @@ def load_checkpoint(executor, dirname: str, main_program=None,
     ckpt_dir = os.path.join(dirname, f"step_{meta['step']}")
     io_mod.load_persistables(executor, ckpt_dir, main_program=main_program)
     if reader is not None:
-        reader.restore(meta)
+        rpath = os.path.join(dirname,
+                             _reader_state_file(jax.process_index()))
+        rstate = None
+        if os.path.exists(rpath):
+            with open(rpath) as f:
+                cand = json.load(f)
+            # only trust a position recorded at this checkpoint's step: a
+            # stale file (e.g. from a later, incomplete save) must not
+            # skew the resume point
+            if cand.get("step") == meta["step"]:
+                rstate = cand
+        if rstate is None and is_save_leader():
+            # the meta's reader fields ARE the leader's own position
+            rstate = meta
+        if rstate is not None:
+            reader.restore(rstate)
+        # a non-leader with no consistent per-process file keeps the fresh
+        # (pass-start) position: replaying its shard is at-least-once
+        # safe, whereas adopting the LEADER's offset could skip samples
     return meta

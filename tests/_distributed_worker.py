@@ -1,0 +1,91 @@
+"""Child process for test_jax_distributed: a real 2-process jax.distributed
+bring-up on the CPU backend (localhost coordinator), the moral equivalent
+of the reference's localhost pserver test
+(reference python/paddle/fluid/tests/unittests/test_recv_op.py:26-36).
+
+Run as:  python _distributed_worker.py <coordinator> <nprocs> <pid>
+
+Prints one line `RESULT <json>` on success. Kept importable without pytest
+so both children stay lightweight."""
+
+import json
+import os
+import sys
+
+
+def main(coordinator, nprocs, pid):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # one local CPU device per process: the 2-process mesh is 2 devices
+    os.environ.setdefault("XLA_FLAGS", "")
+    import numpy as np
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.parallel import multihost
+
+    assert multihost.initialize(coordinator_address=coordinator,
+                                num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert jax.process_index() == pid
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    assert len(devs) == nprocs, devs
+    mesh = Mesh(np.array(devs), ("dp",))
+
+    # 1) cross-process psum: each process contributes (pid + 1); the
+    # replicated sum must be visible on every process
+    local = np.full((1, 4), pid + 1, np.float32)
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), local, (nprocs, 4))
+    total = jax.jit(jnp.sum,
+                    out_shardings=NamedSharding(mesh, P()))(garr)
+    psum_val = float(np.asarray(total))
+    want = sum(range(1, nprocs + 1)) * 4.0
+    assert psum_val == want, (psum_val, want)
+
+    # 2) one sharded SGD step: batch sharded over the 2-process 'dp' axis,
+    # params replicated — XLA inserts the cross-host gradient AllReduce.
+    # Identical data/init on both processes => loss must equal the
+    # single-process oracle computed locally.
+    rng = np.random.default_rng(0)
+    x_all = rng.standard_normal((4, 8)).astype(np.float32)
+    y_all = rng.standard_normal((4, 1)).astype(np.float32)
+    w0 = rng.standard_normal((8, 1)).astype(np.float32) * 0.1
+
+    def loss_fn(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    def step(w, x, y):
+        g = jax.grad(loss_fn)(w, x, y)
+        w = w - 0.1 * g
+        return w, loss_fn(w, x, y)
+
+    # oracle on host numpy (single process math)
+    import numpy.linalg  # noqa: F401
+    gw = (2.0 / 4) * x_all.T @ (x_all @ w0 - y_all)
+    w1 = w0 - 0.1 * gw
+    oracle = float(np.mean((x_all @ w1 - y_all) ** 2))
+
+    per = x_all.shape[0] // nprocs
+    x_g = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), x_all[pid * per:(pid + 1) * per],
+        x_all.shape)
+    y_g = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), y_all[pid * per:(pid + 1) * per],
+        y_all.shape)
+    w_g = jax.device_put(w0, NamedSharding(mesh, P()))
+    _, loss = jax.jit(step, out_shardings=(
+        NamedSharding(mesh, P()), NamedSharding(mesh, P())))(w_g, x_g, y_g)
+    loss = float(np.asarray(loss))
+    assert abs(loss - oracle) < 1e-5, (loss, oracle)
+
+    print(f"RESULT {json.dumps({'pid': pid, 'psum': psum_val, 'loss': loss})}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
