@@ -4,8 +4,9 @@ synthetic surrogate with the real schema unless real files are present
 under common.DATA_HOME (see common.py)."""
 
 from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
-               movielens, sentiment, uci_housing, voc2012, wmt14, wmt16)
+               movielens, mq2007, sentiment, uci_housing, voc2012, wmt14,
+               wmt16)
 
 __all__ = ["cifar", "common", "conll05", "flowers", "imdb", "imikolov",
-           "mnist", "movielens", "sentiment", "uci_housing", "voc2012",
-           "wmt14", "wmt16"]
+           "mnist", "movielens", "mq2007", "sentiment", "uci_housing",
+           "voc2012", "wmt14", "wmt16"]

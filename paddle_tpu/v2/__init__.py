@@ -8,9 +8,11 @@ fluid Programs underneath — one stack, two API skins, exactly how the
 reference's book examples moved from v2 to fluid without retraining users.
 """
 
-from . import activation, data_type, event, layer, optimizer, parameters
+from . import (activation, data_type, evaluator, event, image, layer,
+               networks, optimizer, parameters, pooling)
 from .inference import infer
 from .trainer import SGD
 
-__all__ = ["activation", "data_type", "event", "layer", "optimizer",
-           "parameters", "infer", "SGD"]
+__all__ = ["activation", "data_type", "evaluator", "event", "image",
+           "layer", "networks", "optimizer", "parameters", "pooling",
+           "infer", "SGD"]

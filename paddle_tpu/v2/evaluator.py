@@ -1,0 +1,26 @@
+"""v2 evaluator shims (reference: python/paddle/v2/evaluator.py exposing
+trainer_config_helpers/evaluators.py — classification_error_evaluator,
+auc_evaluator, ... wired into the topology). Here each call appends the
+corresponding fluid metric op to the default program and returns the
+metric Variable — fetch it alongside the cost to monitor it, which is
+exactly how the v2 trainer surfaced evaluator values in events."""
+
+from __future__ import annotations
+
+from .. import layers as fluid_layers
+
+__all__ = ["classification_error", "auc"]
+
+
+def classification_error(input, label, name=None):
+    """Fraction misclassified = 1 - accuracy (reference
+    classification_error_evaluator). `input` is the prediction
+    (post-softmax or logits; argmax is rank-invariant)."""
+    acc = fluid_layers.accuracy(input=input, label=label)
+    one = fluid_layers.fill_constant(shape=[1], dtype=acc.dtype, value=1.0)
+    return fluid_layers.elementwise_sub(one, acc)
+
+
+def auc(input, label, name=None):
+    """Area under ROC (reference auc_evaluator; fluid auc op)."""
+    return fluid_layers.auc(input=input, label=label)

@@ -1,0 +1,127 @@
+"""v2 image augmentation (reference: python/paddle/v2/image.py — cv2-based
+load/resize/crop/flip/transform helpers feeding the image pipelines).
+
+Pure-numpy reimplementation: this environment (and many TPU hosts) has no
+cv2, and none of these transforms need it — bilinear resize is a gather +
+lerp, crops are slices. Images are HWC uint8/float arrays; `simple_transform`
+mirrors the reference's train/test pipeline contract (resize short edge →
+center/random crop → optional flip → CHW float → optional mean subtract).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["resize_short", "to_chw", "center_crop", "random_crop",
+           "left_right_flip", "simple_transform", "load_and_transform",
+           "batch_images"]
+
+
+def _resize_bilinear(im: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Bilinear resize of an HWC (or HW) array without cv2."""
+    im2d = im[:, :, None] if im.ndim == 2 else im
+    ih, iw, c = im2d.shape
+    if (ih, iw) == (h, w):
+        out = im2d
+    else:
+        # sample positions in source coordinates (align_corners=False)
+        ys = (np.arange(h) + 0.5) * ih / h - 0.5
+        xs = (np.arange(w) + 0.5) * iw / w - 0.5
+        y0 = np.clip(np.floor(ys).astype(int), 0, ih - 1)
+        x0 = np.clip(np.floor(xs).astype(int), 0, iw - 1)
+        y1 = np.clip(y0 + 1, 0, ih - 1)
+        x1 = np.clip(x0 + 1, 0, iw - 1)
+        wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+        wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+        f = im2d.astype(np.float32)
+        top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+        bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+        out = top * (1 - wy) + bot * wy
+        if np.issubdtype(im.dtype, np.integer):
+            out = np.clip(np.rint(out), 0, 255).astype(im.dtype)
+        else:
+            out = out.astype(im.dtype)
+    return out[:, :, 0] if im.ndim == 2 else out
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Resize so the SHORT edge equals `size`, keeping aspect ratio
+    (reference image.py resize_short)."""
+    h, w = im.shape[:2]
+    if h < w:
+        return _resize_bilinear(im, size, int(round(w * size / h)))
+    return _resize_bilinear(im, int(round(h * size / w)), size)
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)) -> np.ndarray:
+    """HWC -> CHW (reference to_chw)."""
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im: np.ndarray, size: int, is_color=True) -> np.ndarray:
+    h, w = im.shape[:2]
+    h0, w0 = (h - size) // 2, (w - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color=True,
+                rng: np.random.RandomState = None) -> np.ndarray:
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h0 = rng.randint(0, h - size + 1)
+    w0 = rng.randint(0, w - size + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im: np.ndarray, is_color=True) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color=True, mean=None,
+                     rng: np.random.RandomState = None) -> np.ndarray:
+    """The reference's canonical pipeline (image.py simple_transform):
+    resize short edge, then random crop + coin-flip mirror when training /
+    center crop when testing, HWC->CHW float32, optional mean subtraction
+    (scalar, per-channel, or full-element mean array)."""
+    rng = rng or np.random
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if rng.randint(0, 2) == 1:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if im.ndim == 2:
+        im = im[:, :, None]
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, dtype=np.float32)
+        if mean.ndim == 1:
+            mean = mean[:, None, None]      # per-channel
+        im -= mean
+    return im
+
+
+def load_and_transform(filename: str, resize_size: int, crop_size: int,
+                       is_train: bool, is_color=True, mean=None):
+    """File loader + simple_transform. Supports .npy arrays natively; PNG
+    and JPEG decode requires PIL if available (cv2-free)."""
+    if filename.endswith(".npy"):
+        im = np.load(filename)
+    else:
+        try:
+            from PIL import Image  # optional; not a hard dependency
+        except ImportError as e:
+            raise RuntimeError(
+                "image decode needs PIL (or pre-decoded .npy arrays); "
+                "cv2 is deliberately not a dependency") from e
+        im = np.asarray(Image.open(filename))
+    return simple_transform(im, resize_size, crop_size, is_train,
+                            is_color=is_color, mean=mean)
+
+
+def batch_images(images) -> np.ndarray:
+    """Stack a list of CHW images into an NCHW batch."""
+    return np.stack([np.asarray(im) for im in images], axis=0)

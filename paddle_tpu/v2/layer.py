@@ -1,12 +1,38 @@
 """v2 layer DSL (reference: python/paddle/v2/layer.py + trainer_config_
 helpers/layers.py wrappers). Each call builds fluid IR in the default
 program; the returned Variables ARE the v2 "Layer" handles (the reference
-wrapped config-proto nodes; here the IR is the config)."""
+wrapped config-proto nodes; here the IR is the config).
+
+Coverage follows the layers a reference v2 script actually touches: data /
+fc / embedding / conv / pool / batch_norm / recurrent (lstmemory, grumemory
+and the simple_* fronts in networks.py) / sequence pooling + slicing /
+elementwise combinators (addto, concat, dotmul, mixed-free) / costs +
+similarity heads. Unknown-kwarg policy (ADVICE r3): parameter-affecting
+kwargs (param_attr/bias_attr/name) are FORWARDED, layout-only ones the TPU
+build doesn't need are accepted and ignored by name, anything else raises
+so silent config drift cannot happen."""
 
 from __future__ import annotations
 
 from .. import layers as fluid_layers
+from ..param_attr import ParamAttr
 from .activation import _Act
+from .pooling import pool_name
+
+# kwargs that configured the legacy C++ engine's layout/devices and have
+# no TPU meaning; accepted (and discarded) by every wrapper for source
+# compatibility with reference configs
+_IGNORED_KW = {"layer_attr", "device", "drop_rate", "error_clipping_threshold",
+               "is_static", "initial_std", "initial_mean", "learning_rate",
+               "momentum", "sparse_update"}
+
+
+def _split_kw(kw, where):
+    ignored = {k: kw.pop(k) for k in list(kw) if k in _IGNORED_KW}
+    if kw:
+        raise TypeError(f"{where}: unsupported kwargs {sorted(kw)} "
+                        "(would silently change the model)")
+    return ignored
 
 
 def _act_name(act):
@@ -15,6 +41,17 @@ def _act_name(act):
     if isinstance(act, _Act) or isinstance(act, type) and issubclass(act, _Act):
         return act.name
     return act
+
+
+def _as_attr(attr):
+    """v2 parameter_attribute -> fluid ParamAttr (name passthrough)."""
+    if attr is None or isinstance(attr, ParamAttr):
+        return attr
+    if isinstance(attr, str):
+        return ParamAttr(name=attr)
+    if isinstance(attr, dict):
+        return ParamAttr(**attr)
+    return attr
 
 
 def data(name, type):
@@ -27,29 +64,114 @@ def data(name, type):
                              lod_level=type.seq)
 
 
-def fc(input, size, act=None, **kw):
-    return fluid_layers.fc(input=input, size=size, act=_act_name(act))
+def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None,
+       num_flatten_dims=1, **kw):
+    """Fully connected (reference fc_layer). param_attr/bias_attr/name are
+    forwarded — v2 code names parameters for sharing and decode-time reuse
+    (ADVICE r3: silently dropping them broke that)."""
+    _split_kw(kw, "fc")
+    return fluid_layers.fc(input=input, size=size, act=_act_name(act),
+                           param_attr=_as_attr(param_attr),
+                           bias_attr=_as_attr(bias_attr), name=name,
+                           num_flatten_dims=num_flatten_dims)
 
 
-def embedding(input, size, **kw):
+def embedding(input, size, param_attr=None, **kw):
     """size = embedding dim (reference embedding_layer); the vocab extent
     comes from the data layer's integer_value range."""
     vocab = kw.pop("vocab_size", None)
     if vocab is None:
         vocab = kw.pop("input_range", None)
+    _split_kw(kw, "embedding")
     if vocab is None:
         raise ValueError("embedding needs vocab_size= (the reference reads "
                          "it from the data layer's integer_value range)")
-    return fluid_layers.embedding(input=input, size=[vocab, size])
+    return fluid_layers.embedding(input=input, size=[vocab, size],
+                                  param_attr=_as_attr(param_attr))
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
+             padding=0, act=None, param_attr=None, bias_attr=None, **kw):
+    """Image convolution (reference img_conv_layer)."""
+    _split_kw(kw, "img_conv")
+    return fluid_layers.conv2d(input=input, num_filters=num_filters,
+                               filter_size=filter_size, stride=stride,
+                               padding=padding, act=_act_name(act),
+                               param_attr=_as_attr(param_attr),
+                               bias_attr=_as_attr(bias_attr))
+
+
+def img_pool(input, pool_size, stride=1, padding=0, pool_type="max", **kw):
+    """Spatial pooling (reference img_pool_layer)."""
+    _split_kw(kw, "img_pool")
+    return fluid_layers.pool2d(input=input, pool_size=pool_size,
+                               pool_type=pool_name(pool_type),
+                               pool_stride=stride, pool_padding=padding)
+
+
+def batch_norm(input, act=None, is_test=False, param_attr=None,
+               bias_attr=None, **kw):
+    """Batch normalization (reference batch_norm_layer)."""
+    _split_kw(kw, "batch_norm")
+    return fluid_layers.batch_norm(input=input, act=_act_name(act),
+                                   is_test=is_test,
+                                   param_attr=_as_attr(param_attr),
+                                   bias_attr=_as_attr(bias_attr))
+
+
+def dropout(input, dropout_rate, **kw):
+    """(reference dropout_layer)."""
+    _split_kw(kw, "dropout")
+    return fluid_layers.dropout(input, dropout_prob=dropout_rate)
+
+
+def lstmemory(input, size=None, reverse=False, act=None, **kw):
+    """LSTM over a projected sequence (reference lstmemory: input must
+    already be the 4x-gate projection; `size` is the HIDDEN width, so the
+    input must be 4*size wide — fluid dynamic_lstm's size param is the
+    4x-gate width). Returns the hidden sequence."""
+    _split_kw(kw, "lstmemory")
+    hidden = size or input.shape[-1] // 4
+    if input.shape[-1] != 4 * hidden:
+        raise ValueError(
+            f"lstmemory(size={hidden}) needs a 4*size={4 * hidden}-wide "
+            f"gate projection as input, got width {input.shape[-1]} "
+            "(reference lstmemory contract)")
+    h, _c = fluid_layers.dynamic_lstm(input=input, size=4 * hidden,
+                                      is_reverse=reverse)
+    return h
+
+
+def grumemory(input, size=None, reverse=False, act=None, **kw):
+    """GRU over a projected sequence (reference grumemory; input is the
+    3x-gate projection). Returns the hidden sequence."""
+    _split_kw(kw, "grumemory")
+    size = size or input.shape[-1] // 3
+    return fluid_layers.dynamic_gru(input=input, size=size,
+                                    is_reverse=reverse)
 
 
 def simple_lstm(input, size, **kw):
     """fc projection + LSTM (reference trainer_config_helpers simple_lstm =
     mixed+lstmemory); returns the hidden sequence."""
+    _split_kw(kw, "simple_lstm")
     proj = fluid_layers.fc(input=input, size=size * 4, num_flatten_dims=2)
     h, _c = fluid_layers.dynamic_lstm(input=proj, size=size * 4)
     return h
 
+
+def recurrent(input, act=None, reverse=False, **kw):
+    """Simple (vanilla) recurrent layer (reference recurrent_layer) built
+    as a 1-gate GRU-free recurrence: fluid has no plain-RNN fused op, so
+    use dynamic_gru on a tripled projection — same sequence contract."""
+    _split_kw(kw, "recurrent")
+    size = input.shape[-1]
+    proj = fluid_layers.fc(input=input, size=size * 3, num_flatten_dims=2)
+    return fluid_layers.dynamic_gru(input=proj, size=size,
+                                    is_reverse=reverse)
+
+
+# --- sequence ops ------------------------------------------------------------
 
 def last_seq(input):
     return fluid_layers.sequence_last_step(input)
@@ -57,6 +179,13 @@ def last_seq(input):
 
 def first_seq(input):
     return fluid_layers.sequence_first_step(input)
+
+
+def pooling(input, pooling_type="max", **kw):
+    """Sequence pooling with a pooling-type marker (reference
+    pooling_layer + v2/pooling.py)."""
+    _split_kw(kw, "pooling")
+    return fluid_layers.sequence_pool(input, pool_name(pooling_type))
 
 
 def max_pooling(input):
@@ -67,13 +196,83 @@ def sum_pooling(input):
     return fluid_layers.sequence_pool(input, "sum")
 
 
-def concat(input):
+def avg_pooling(input):
+    return fluid_layers.sequence_pool(input, "average")
+
+
+def expand(input, expand_as, **kw):
+    """Broadcast per-sequence values across steps (reference
+    expand_layer)."""
+    _split_kw(kw, "expand")
+    return fluid_layers.sequence_expand(input, expand_as)
+
+
+def seq_concat(a, b, **kw):
+    """Concatenate two sequences in TIME — output length is
+    len(a) + len(b) per sequence (reference seq_concat_layer; lowers to
+    the fluid sequence_concat op)."""
+    _split_kw(kw, "seq_concat")
+    return fluid_layers.sequence_concat([a, b])
+
+
+# --- combinators -------------------------------------------------------------
+
+def concat(input, **kw):
+    _split_kw(kw, "concat")
     return fluid_layers.concat(input, axis=1)
 
+
+def addto(input, act=None, bias_attr=None, **kw):
+    """Elementwise sum of N inputs (reference addto_layer)."""
+    _split_kw(kw, "addto")
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = fluid_layers.elementwise_add(out, x)
+    act = _act_name(act)
+    if act:
+        out = getattr(fluid_layers, act)(out)
+    return out
+
+
+def dotmul_operator(a, b, scale=1.0):
+    """Elementwise product (reference dotmul_operator)."""
+    out = fluid_layers.elementwise_mul(a, b)
+    if scale != 1.0:
+        out = fluid_layers.scale(out, scale=float(scale))
+    return out
+
+
+def cos_sim(a, b, scale=1.0, **kw):
+    """Cosine similarity head (reference cos_sim; recommender_system's
+    matching score)."""
+    _split_kw(kw, "cos_sim")
+    out = fluid_layers.cos_sim(a, b)
+    if scale != 1.0:
+        out = fluid_layers.scale(out, scale=float(scale))
+    return out
+
+
+def slope_intercept(input, slope=1.0, intercept=0.0):
+    """y = slope*x + intercept (reference slope_intercept_layer)."""
+    return fluid_layers.scale(input, scale=float(slope),
+                              bias=float(intercept))
+
+
+def trans(input, **kw):
+    """2-D transpose (reference trans_layer)."""
+    _split_kw(kw, "trans")
+    return fluid_layers.transpose(input, perm=[1, 0])
+
+
+# --- costs -------------------------------------------------------------------
 
 def square_error_cost(input, label):
     return fluid_layers.mean(
         fluid_layers.square_error_cost(input=input, label=label))
+
+
+mse_cost = square_error_cost
 
 
 def classification_cost(input, label):
@@ -86,3 +285,25 @@ def classification_cost(input, label):
 def cross_entropy_cost(input, label):
     return fluid_layers.mean(
         fluid_layers.cross_entropy(input=input, label=label))
+
+
+def rank_cost(left, right, label, **kw):
+    """Pairwise RankNet cost (reference rank_cost): P = sigmoid(sl - sr),
+    cross-entropied against the pair label (mq2007 pairwise training)."""
+    _split_kw(kw, "rank_cost")
+    diff = fluid_layers.elementwise_sub(left, right)
+    return fluid_layers.mean(
+        fluid_layers.sigmoid_cross_entropy_with_logits(x=diff, label=label))
+
+
+def huber_regression_cost(input, label, delta=1.0, **kw):
+    """Huber loss with knee at |d| = delta: 0.5 d^2 inside,
+    delta*|d| - 0.5*delta^2 outside. Via the scaling identity
+    huber(d, delta) = delta^2 * huber(d/delta, 1), where huber(., 1) is
+    exactly smooth_l1 at sigma=1."""
+    _split_kw(kw, "huber_regression_cost")
+    delta = float(delta)
+    unit = fluid_layers.smooth_l1(
+        x=fluid_layers.scale(input, scale=1.0 / delta),
+        y=fluid_layers.scale(label, scale=1.0 / delta), sigma=1.0)
+    return fluid_layers.scale(fluid_layers.mean(unit), scale=delta * delta)

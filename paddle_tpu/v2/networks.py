@@ -1,0 +1,93 @@
+"""v2 composite networks (reference: python/paddle/v2/networks.py exposing
+trainer_config_helpers/networks.py — simple_img_conv_pool, img_conv_group,
+vgg_16_network, sequence_conv_pool, simple_lstm, bidirectional_lstm,
+simple_gru). Thin v2-flavored fronts over the fluid nets/layers tier, so a
+reference v2 script's network calls translate one-to-one."""
+
+from __future__ import annotations
+
+from .. import layers as fluid_layers
+from .. import nets as fluid_nets
+from .activation import _Act
+from .pooling import pool_name
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "vgg_16_network",
+           "sequence_conv_pool", "simple_lstm", "bidirectional_lstm",
+           "simple_gru"]
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, _Act) or (isinstance(act, type)
+                                 and issubclass(act, _Act)):
+        return act.name
+    return act
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, pool_type="max", **kw):
+    """conv2d + pool2d (reference networks.py simple_img_conv_pool; the
+    recognize_digits conv config uses exactly this)."""
+    return fluid_nets.simple_img_conv_pool(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        pool_size=pool_size, pool_stride=pool_stride,
+        act=_act_name(act), pool_type=pool_name(pool_type))
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, conv_with_batchnorm=False,
+                   pool_stride=1, pool_type="max", **kw):
+    """N convs (+optional BN) then one pool — the VGG block (reference
+    networks.py img_conv_group)."""
+    return fluid_nets.img_conv_group(
+        input=input, conv_num_filter=conv_num_filter, pool_size=pool_size,
+        conv_padding=conv_padding, conv_filter_size=conv_filter_size,
+        conv_act=_act_name(conv_act),
+        conv_with_batchnorm=conv_with_batchnorm,
+        pool_stride=pool_stride, pool_type=pool_name(pool_type))
+
+
+def vgg_16_network(input_image, num_channels=3, num_classes=1000):
+    """The classic VGG-16 stack (reference networks.py vgg_16_network);
+    returns softmax probabilities like the reference config did."""
+    from ..models import vgg16
+    logits = vgg16(input_image, class_dim=num_classes)
+    return fluid_layers.softmax(logits)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, act=None,
+                       pool_type="max", **kw):
+    """Context-window conv over a sequence + pooling (reference
+    networks.py sequence_conv_pool; text_conv configs)."""
+    return fluid_nets.sequence_conv_pool(
+        input=input, num_filters=hidden_size, filter_size=context_len,
+        act=_act_name(act) or "tanh", pool_type=pool_name(pool_type))
+
+
+def simple_lstm(input, size, act=None, **kw):
+    """fc(4*size) projection + LSTM; returns the hidden sequence
+    (reference networks.py simple_lstm = mixed + lstmemory)."""
+    proj = fluid_layers.fc(input=input, size=size * 4, num_flatten_dims=2)
+    h, _c = fluid_layers.dynamic_lstm(input=proj, size=size * 4)
+    return h
+
+
+def bidirectional_lstm(input, size, return_unmerged=False, **kw):
+    """Forward + backward LSTM over the sequence, concatenated on the
+    feature axis (reference networks.py bidirectional_lstm)."""
+    fw_proj = fluid_layers.fc(input=input, size=size * 4, num_flatten_dims=2)
+    fw, _ = fluid_layers.dynamic_lstm(input=fw_proj, size=size * 4)
+    bw_proj = fluid_layers.fc(input=input, size=size * 4, num_flatten_dims=2)
+    bw, _ = fluid_layers.dynamic_lstm(input=bw_proj, size=size * 4,
+                                      is_reverse=True)
+    if return_unmerged:
+        return fw, bw
+    return fluid_layers.concat([fw, bw], axis=-1)
+
+
+def simple_gru(input, size, act=None, **kw):
+    """fc(3*size) projection + GRU; returns the hidden sequence
+    (reference networks.py simple_gru)."""
+    proj = fluid_layers.fc(input=input, size=size * 3, num_flatten_dims=2)
+    return fluid_layers.dynamic_gru(input=proj, size=size)

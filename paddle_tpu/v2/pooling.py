@@ -1,0 +1,53 @@
+"""v2 pooling-type markers (reference: python/paddle/v2/pooling.py re-
+exporting trainer_config_helpers/poolings.py classes). Passed as the
+`pooling_type=` argument of v2 sequence pooling / networks wrappers; each
+carries the fluid sequence_pool name it lowers to."""
+
+from __future__ import annotations
+
+__all__ = ["Max", "Avg", "Sum", "SqrtN", "CudnnMax", "CudnnAvg"]
+
+
+class BasePoolingType:
+    name = None
+
+    def __init__(self):
+        pass
+
+
+class Max(BasePoolingType):
+    name = "max"
+
+
+class Avg(BasePoolingType):
+    name = "average"
+
+
+class Sum(BasePoolingType):
+    name = "sum"
+
+
+class SqrtN(BasePoolingType):
+    """Sum scaled by 1/sqrt(len) (reference SqrtN for sequence bow)."""
+    name = "sqrt"
+
+
+# cudnn variants are spatial-pool markers in the reference; on TPU they
+# alias the plain types (XLA owns the pooling implementation)
+class CudnnMax(Max):
+    pass
+
+
+class CudnnAvg(Avg):
+    pass
+
+
+def pool_name(p) -> str:
+    """Accept a class, an instance, or a plain string."""
+    if isinstance(p, str):
+        return p
+    if isinstance(p, type) and issubclass(p, BasePoolingType):
+        return p.name
+    if isinstance(p, BasePoolingType):
+        return p.name
+    raise TypeError(f"not a pooling type: {p!r}")

@@ -1,0 +1,194 @@
+"""v2 user-surface breadth (VERDICT r3 missing #3): networks composites,
+numpy image augmentation, pooling/evaluator shims, mq2007 dataset, and the
+acceptance bar — a reference-shaped v2 sentiment-LSTM script that touches
+ONLY paddle_tpu.v2.* end-to-end (reference python/paddle/v2 demo style)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import v2 as paddle
+
+
+class TestV2Networks:
+    def test_sentiment_lstm_end_to_end(self):
+        """The VERDICT acceptance script: data -> embedding -> simple_lstm
+        -> pooling -> fc -> classification_cost, trained by the v2 SGD
+        event loop on the imdb reader surface, then infer()."""
+        from paddle_tpu.dataset import imdb
+
+        vocab = len(imdb.word_dict())
+        words = paddle.layer.data(
+            name="words", type=paddle.data_type.integer_value_sequence(vocab))
+        label = paddle.layer.data(
+            name="label", type=paddle.data_type.integer_value(2))
+        emb = paddle.layer.embedding(input=words, size=32, vocab_size=vocab)
+        lstm = paddle.networks.simple_lstm(input=emb, size=32)
+        pooled = paddle.layer.pooling(lstm,
+                                      pooling_type=paddle.pooling.Max)
+        logits = paddle.layer.fc(input=pooled, size=2,
+                                 act=paddle.activation.Linear)
+        cost = paddle.layer.classification_cost(input=logits, label=label)
+
+        parameters = paddle.parameters.create(cost)
+        trainer = paddle.SGD(
+            cost=cost, parameters=parameters,
+            update_equation=paddle.optimizer.Adam(learning_rate=1e-2))
+
+        def reader():
+            src = imdb.train()()
+            batch = []
+            for i, (ws, lab) in enumerate(src):
+                if i >= 96:
+                    break
+                batch.append((ws, [lab]))
+                if len(batch) == 16:
+                    yield batch
+                    batch = []
+
+        costs = []
+
+        def handler(e):
+            if isinstance(e, paddle.event.EndIteration):
+                costs.append(e.cost)
+
+        trainer.train(reader, num_passes=8, event_handler=handler,
+                      feeding={"words": 0, "label": 1})
+        assert np.isfinite(costs).all()
+        # synthetic imdb splits vocab by sentiment: easily separable
+        assert costs[-1] < costs[0] * 0.6, (costs[0], costs[-1])
+
+        out = paddle.infer(output_layer=logits, parameters=parameters,
+                           input=[([5, 6, 7],), ([3000, 3001],)],
+                           feeding={"words": 0})
+        assert np.asarray(out).shape == (2, 2)
+
+    def test_img_conv_pool_and_group(self):
+        import paddle_tpu as fluid
+        img = paddle.layer.data(name="im",
+                                type=paddle.data_type.dense_vector(3 * 16 * 16))
+        img4 = fluid.layers.reshape(img, [-1, 3, 16, 16])
+        c1 = paddle.networks.simple_img_conv_pool(
+            input=img4, filter_size=3, num_filters=4, pool_size=2,
+            pool_stride=2, act=paddle.activation.Relu())
+        g = paddle.networks.img_conv_group(
+            input=img4, conv_num_filter=[4, 4], pool_size=2,
+            conv_act=paddle.activation.Relu())
+        # conv 3x3 valid on 16 -> 14, pool 2/2 -> 7; group keeps channels
+        assert c1.shape[-1] == 7 and g.shape[1] == 4
+
+    def test_bidirectional_lstm_and_gru_shapes(self):
+        vocab = 50
+        w = paddle.layer.data(
+            name="w2", type=paddle.data_type.integer_value_sequence(vocab))
+        emb = paddle.layer.embedding(input=w, size=8, vocab_size=vocab)
+        bi = paddle.networks.bidirectional_lstm(input=emb, size=8)
+        gru = paddle.networks.simple_gru(input=emb, size=8)
+        assert bi.shape[-1] == 16 and gru.shape[-1] == 8
+
+
+class TestV2Image:
+    def test_simple_transform_train_and_test(self):
+        from paddle_tpu.v2 import image as v2_image
+        rng = np.random.RandomState(0)
+        im = rng.randint(0, 255, (40, 60, 3)).astype(np.uint8)
+        test_out = v2_image.simple_transform(im, 32, 24, is_train=False,
+                                             mean=[1.0, 2.0, 3.0])
+        assert test_out.shape == (3, 24, 24) and test_out.dtype == np.float32
+        train_out = v2_image.simple_transform(
+            im, 32, 24, is_train=True, rng=np.random.RandomState(3))
+        assert train_out.shape == (3, 24, 24)
+        batch = v2_image.batch_images([test_out, train_out])
+        assert batch.shape == (2, 3, 24, 24)
+
+    def test_resize_short_keeps_aspect(self):
+        from paddle_tpu.v2 import image as v2_image
+        im = np.arange(20 * 10 * 3, dtype=np.uint8).reshape(20, 10, 3)
+        out = v2_image.resize_short(im, 5)
+        assert out.shape == (10, 5, 3)
+        # constant image resizes to the same constant (bilinear sanity)
+        const = np.full((8, 12, 3), 77, np.uint8)
+        out2 = v2_image.resize_short(const, 6)
+        assert (out2 == 77).all()
+
+    def test_flip_and_crops(self):
+        from paddle_tpu.v2 import image as v2_image
+        im = np.arange(16).reshape(4, 4).astype(np.float32)
+        np.testing.assert_array_equal(v2_image.left_right_flip(im),
+                                      im[:, ::-1])
+        assert v2_image.center_crop(im, 2).shape == (2, 2)
+        assert v2_image.random_crop(
+            im, 2, rng=np.random.RandomState(0)).shape == (2, 2)
+
+
+class TestV2Evaluator:
+    def test_classification_error(self):
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod
+        pred = paddle.layer.data(name="p",
+                                 type=paddle.data_type.dense_vector(3))
+        lab = paddle.layer.data(name="l",
+                                type=paddle.data_type.integer_value(3))
+        err = paddle.evaluator.classification_error(input=pred, label=lab)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            p = np.array([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
+                          [0.3, 0.3, 0.4], [0.9, 0.05, 0.05]], np.float32)
+            y = np.array([[0], [1], [1], [2]], np.int64)  # 2 right, 2 wrong
+            from paddle_tpu.framework.framework import default_main_program
+            got, = exe.run(default_main_program(), feed={"p": p, "l": y},
+                           fetch_list=[err])
+        assert abs(float(np.ravel(got)[0]) - 0.5) < 1e-6
+
+
+class TestMQ2007:
+    def test_pairwise_reader_schema(self):
+        from paddle_tpu.dataset import mq2007
+        it = mq2007.train(format="pairwise")()
+        label, hi, lo = next(it)
+        assert label == 1.0 and hi.shape == (46,) and lo.shape == (46,)
+
+    def test_listwise_and_pointwise(self):
+        from paddle_tpu.dataset import mq2007
+        rels, feats = next(mq2007.test(format="listwise")())
+        assert feats.shape == (len(rels), 46)
+        f, r = next(mq2007.test(format="pointwise")())
+        assert f.shape == (46,) and r in (0.0, 1.0, 2.0)
+
+    def test_ranknet_learns_pairwise_order(self):
+        """rank_cost over mq2007 pairs: the planted LETOR signal must be
+        learnable through the v2 surface (reference ssd/rank demos)."""
+        from paddle_tpu.dataset import mq2007
+        left = paddle.layer.data(name="left",
+                                 type=paddle.data_type.dense_vector(46))
+        right = paddle.layer.data(name="right",
+                                  type=paddle.data_type.dense_vector(46))
+        lab = paddle.layer.data(name="lab",
+                                type=paddle.data_type.dense_vector(1))
+        shared = paddle.layer.fc  # one scoring tower, shared weights
+        sl = shared(input=left, size=1, param_attr="rank_w",
+                    bias_attr="rank_b")
+        sr = shared(input=right, size=1, param_attr="rank_w",
+                    bias_attr="rank_b")
+        cost = paddle.layer.rank_cost(left=sl, right=sr, label=lab)
+        parameters = paddle.parameters.create(cost)
+        trainer = paddle.SGD(
+            cost=cost, parameters=parameters,
+            update_equation=paddle.optimizer.Adam(learning_rate=1e-2))
+
+        def reader():
+            batch = []
+            for i, (y, hi, lo) in enumerate(mq2007.train()()):
+                if i >= 256:
+                    break
+                batch.append((hi, lo, [y]))
+                if len(batch) == 32:
+                    yield batch
+                    batch = []
+
+        costs = []
+        trainer.train(
+            reader, num_passes=3,
+            event_handler=lambda e: costs.append(e.cost) if isinstance(
+                e, paddle.event.EndIteration) else None,
+            feeding={"left": 0, "right": 1, "lab": 2})
+        assert costs[-1] < costs[0] * 0.9, (costs[0], costs[-1])
