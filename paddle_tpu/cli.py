@@ -169,9 +169,15 @@ def cmd_checkgrad(args):
     scope = executor_mod.global_scope()
 
     def run_loss():
+        # pin the PRNG stream: the executor advances __rng_counter__ every
+        # run, so without this a config with random ops (dropout,
+        # uniform_random) would draw different noise per evaluation and
+        # the central difference would measure noise, not gradient
+        scope.set_var("__rng_counter__", 0)
         out, = exe.run(fwd_only, feed=feed, fetch_list=[loss_name])
         return float(np.ravel(out)[0])
 
+    scope.set_var("__rng_counter__", 0)
     outs = exe.run(check, feed=feed, fetch_list=[loss_name] + grads)
     analytic = {p: np.asarray(g) for p, g in zip(params, outs[1:])}
 

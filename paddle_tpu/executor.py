@@ -292,17 +292,20 @@ if os.environ.get("PADDLE_TPU_TRAP_FP", "0") == "1":
     jax.config.update("jax_debug_nans", True)
     jax.config.update("jax_debug_infs", True)
 
-# op-coverage recorder (tools/op_coverage.py): append every executed op type
-# to the named file so a test sweep can prove each registered op runs
+# op-coverage recorder: every executed op type lands in the in-process set
+# (tests/test_zz_op_coverage.py asserts full-registry coverage at the end of
+# a suite run); PADDLE_TPU_RECORD_OPS additionally appends to a file for
+# cross-process reports (tools/op_coverage.py)
 _RECORD_OPS_PATH = os.environ.get("PADDLE_TPU_RECORD_OPS")
 _RECORDED_OPS = set()
 
 
 def _record_op(op_type: str):
-    if _RECORD_OPS_PATH and op_type not in _RECORDED_OPS:
+    if op_type not in _RECORDED_OPS:
         _RECORDED_OPS.add(op_type)
-        with open(_RECORD_OPS_PATH, "a") as f:
-            f.write(op_type + "\n")
+        if _RECORD_OPS_PATH:
+            with open(_RECORD_OPS_PATH, "a") as f:
+                f.write(op_type + "\n")
 
 SEQLEN_SUFFIX = "@SEQLEN"
 SEQLEN2_SUFFIX = "@SEQLEN2"   # inner lengths [B, S] of nested (level-2) LoD

@@ -18,12 +18,16 @@ import os
 from typing import Any, Dict, Tuple
 
 _REGISTRY: Dict[str, Tuple[Any, type, str]] = {}
+# value seen when this module was imported: consuming modules read their
+# PADDLE_TPU_* vars at import time, so THIS is what live code is acting on
+_IMPORT_SNAPSHOT: Dict[str, Any] = {}
 
 
 def define(name: str, default, help_str: str, type_=None):
     t = type_ or type(default)
     _REGISTRY[name] = (default, t, help_str)
-    return get(name)
+    _IMPORT_SNAPSHOT[name] = get(name)
+    return _IMPORT_SNAPSHOT[name]
 
 
 def _parse(raw: str, t: type, default):
@@ -38,6 +42,9 @@ def _parse(raw: str, t: type, default):
 
 
 def get(name: str):
+    """Current environment value. NOTE: most consuming modules snapshot
+    their flag at import time, so an env var changed after import shows
+    here without changing live behavior — compare against snapshot()."""
     default, t, _ = _REGISTRY[name]
     raw = os.environ.get(f"PADDLE_TPU_{name.upper()}")
     if raw is None:
@@ -45,8 +52,23 @@ def get(name: str):
     return _parse(raw, t, default)
 
 
+def snapshot(name: str):
+    """The value at import time — what live modules are actually using."""
+    return _IMPORT_SNAPSHOT[name]
+
+
 def dump() -> Dict[str, Tuple[Any, str]]:
-    return {n: (get(n), h) for n, (_, _, h) in sorted(_REGISTRY.items())}
+    """{name: (value, help)}; when the current env differs from the
+    import-time snapshot the help is annotated, since live modules act on
+    the snapshot, not the new env value."""
+    out = {}
+    for n, (_, _, h) in sorted(_REGISTRY.items()):
+        cur = get(n)
+        if cur != _IMPORT_SNAPSHOT.get(n, cur):
+            h = (f"{h} [env changed after import: active="
+                 f"{_IMPORT_SNAPSHOT[n]!r}, env={cur!r}]")
+        out[n] = (cur, h)
+    return out
 
 
 # --- the catalogue (reference Flags.cpp / executor.cc DEFINE_bool etc.) ----

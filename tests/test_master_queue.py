@@ -79,27 +79,19 @@ class TestTaskQueueSemantics:
         assert q.stats()["todo"] == 2
 
 
-def _worker(d, wid, die_after, out_q):
-    """Consume the stream; optionally crash (sys.exit) mid-task."""
-    q = TaskQueue(d, timeout_s=2.0)
-    seen = []
-    consumed = 0
-    for s in elastic_reader(q, chunk_fetch=lambda c: c, worker=wid)():
-        seen.append(s)
-        consumed += 1
-        if die_after is not None and consumed >= die_after:
-            os._exit(17)               # crash WITHOUT finishing the task
-    out_q.put((wid, seen))
-
-
 class TestElasticWorkers:
     def test_crashed_worker_task_requeues_no_loss(self, tmp_path):
+        # spawn (not fork): forking a jax-initialized parent risks
+        # deadlock; the worker lives in _master_worker.py so the spawned
+        # child never imports jax at all
+        from _master_worker import worker as _worker
+
         d = str(tmp_path)
         q = TaskQueue(d, timeout_s=2.0)
         chunks = [[i * 10 + j for j in range(5)] for i in range(4)]
         q.partition(chunks)
 
-        ctx = mp.get_context("fork")
+        ctx = mp.get_context("spawn")
         out = ctx.Queue()
         # w0 crashes after 2 samples (mid-task); w1 starts after and
         # must pick up the requeued task once the lease expires

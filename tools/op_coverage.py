@@ -1,7 +1,9 @@
 #!/usr/bin/env python
 """Op-coverage report: which registered ops does the test suite execute?
 
-Usage:
+The suite itself enforces coverage continuously (tests/test_zz_op_coverage.py
+reads the in-process record); this tool is the offline report form:
+
     rm -f /tmp/op_coverage.txt
     PADDLE_TPU_RECORD_OPS=/tmp/op_coverage.txt python -m pytest tests/ -q
     python tools/op_coverage.py /tmp/op_coverage.txt
@@ -10,12 +12,20 @@ Usage:
 op_test.py:212; this report proves the same property for the new corpus.)
 """
 
+import os
 import sys
+
+# force the host platform BEFORE importing jax/paddle_tpu: in a TPU-attached
+# terminal a plain setdefault would leave the import initializing the (slow,
+# tunneled) accelerator backend just to read a registry
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 
 def main(path):
-    import os
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if not os.path.exists(path):
+        print(f"no record file at {path} — run the suite with "
+              f"PADDLE_TPU_RECORD_OPS={path} first (see module docstring)")
+        return 2
     import paddle_tpu  # noqa: F401  (registers all ops)
     from paddle_tpu.ops import registry
 
