@@ -1058,9 +1058,12 @@ def fused_attention(q, k, v, causal=False,
     [B, T, D] with num_heads and different semantics.)"""
     helper = LayerHelper("fused_attention")
     out = helper.create_tmp_variable(q.dtype)
+    # per-row logsumexp residual for the explicit backward (dropout-Mask
+    # pattern); stop_gradient — it carries no cotangent of its own
+    lse = helper.create_tmp_variable("float32", stop_gradient=True)
     helper.append_op(type="scaled_dot_product_attention",
                      inputs={"Q": [q], "K": [k], "V": [v]},
-                     outputs={"Out": [out]},
+                     outputs={"Out": [out], "LSE": [lse]},
                      attrs={"causal": causal,
                             "sequence_parallel": sequence_parallel,
                             "use_flash": use_flash})

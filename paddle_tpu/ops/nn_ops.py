@@ -659,43 +659,133 @@ def _sdpa_infer(op_, block):
     qv = in_var(op_, block, "Q")
     if qv is not None:
         set_out(op_, block, "Out", qv.shape, qv.dtype)
+        if "LSE" in op_.desc.outputs:
+            b, t, h = qv.shape[0], qv.shape[1], qv.shape[2]
+            set_out(op_, block, "LSE", [b, h, t], "float32")
 
 
-@op("scaled_dot_product_attention", infer_shape=_sdpa_infer)
+def _sdpa_grad(fwd, no_grad_set):
+    """Explicit grad op consuming the forward's saved LSE (dropout-Mask
+    pattern; reference batch_norm saves statistics the same way). The
+    generic vjp maker would re-trace the forward INSIDE the grad op — for
+    HLO einsums XLA CSEs the duplicate, but pallas custom calls are not
+    CSE'd, so use_flash would pay the flash forward twice per step."""
+    wanted = [s for s in ("Q", "K", "V")
+              if fwd.input(s)[0] not in no_grad_set]
+    if not wanted:
+        return []
+    return [OpDesc(
+        type="scaled_dot_product_attention_grad",
+        inputs={"Q": fwd.input("Q"), "K": fwd.input("K"),
+                "V": fwd.input("V"), "Out": fwd.output("Out"),
+                "LSE": fwd.output("LSE"),
+                "Out@GRAD": [grad_var_name(fwd.output("Out")[0])]},
+        outputs={s + "@GRAD": [grad_var_name(fwd.input(s)[0])]
+                 for s in wanted},
+        attrs=dict(fwd.attrs))]
+
+
+def _sdpa_paths(ctx, op_, q, k, v):
+    """(mode, mesh): 'ring' under sequence_parallel with an sp mesh,
+    'flash' when use_flash and the shape tiles, else 'einsum'."""
+    from . import pallas_attention
+    mesh = getattr(ctx.program, "_mesh", None)
+    if op_.attr("sequence_parallel", False) and mesh is not None and \
+            "sp" in mesh.axis_names:
+        return "ring", mesh
+    if op_.attr("use_flash", False) and pallas_attention.supports(q, k, v):
+        return "flash", None
+    return "einsum", None
+
+
+@op("scaled_dot_product_attention", infer_shape=_sdpa_infer,
+    grad=_sdpa_grad)
 def _scaled_dot_product_attention(ctx, op_, ins):
     """Fused softmax attention, Q/K/V [B, T, H, D] (no 2018-reference
     analogue — the capability the brief requires for long context). With
     sequence_parallel=True and a program mesh carrying an 'sp' axis, the
     computation runs as ring attention (parallel/ring_attention.py):
     sequence shards stay resident per device and K/V rotate over ICI via
-    ppermute, so full-sequence scores never materialize."""
+    ppermute, so full-sequence scores never materialize.
+
+    Also emits LSE, the per-row logsumexp of the scaled scores [B, H, T]
+    (f32) — the residual the flash backward recomputes from. The einsum
+    path derives it from the same logits XLA already CSEs; the ring path
+    emits zeros (its backward re-derives everything through the ring and
+    never reads it)."""
     q = jnp.asarray(ins["Q"][0])
     k = jnp.asarray(ins["K"][0])
     v = jnp.asarray(ins["V"][0])
     causal = op_.attr("causal", False)
     (q, k, v), restore = mxu_cast(ctx, q, k, v)
     from ..parallel.ring_attention import (attention_reference,
+                                           attention_reference_lse,
                                            ring_attention_sharded)
-    mesh = getattr(ctx.program, "_mesh", None)
-    if op_.attr("sequence_parallel", False) and mesh is not None and \
-            "sp" in mesh.axis_names:
+    mode, mesh = _sdpa_paths(ctx, op_, q, k, v)
+    if mode == "ring":
         out = ring_attention_sharded(q, k, v, mesh, axis="sp",
                                      causal=causal,
                                      use_flash=op_.attr("use_flash", False))
-    elif op_.attr("use_flash", False):
+        b, t, h, _d = q.shape
+        lse = jnp.zeros((b, h, t), jnp.float32)
+    elif mode == "flash":
         # Pallas flash attention (ops/pallas_attention.py): O(T) memory
-        # online-softmax VMEM kernel; falls back to the XLA reference for
-        # non-tileable shapes
+        # online-softmax VMEM kernel
         from . import pallas_attention
-        if pallas_attention.supports(q, k, v):
-            out = pallas_attention.flash_attention(q, k, v, causal)
-        else:
-            out = attention_reference(q, k, v, causal=causal)
+        out, lse = pallas_attention._forward(q, k, v, causal,
+                                             return_lse=True)
     else:
         out = attention_reference(q, k, v, causal=causal)
+        lse = attention_reference_lse(q, k, causal=causal)
     if restore is not None:
         out = out.astype(restore)
-    return {"Out": [out]}
+    return {"Out": [out], "LSE": [lse]}
+
+
+@op("scaled_dot_product_attention_grad", grad=NO_GRAD,
+    non_diff_inputs=("LSE",))
+def _sdpa_grad_kernel(ctx, op_, ins):
+    """dQ/dK/dV from the saved (Out, LSE): the flash path runs the Pallas
+    backward kernels directly (ops/pallas_attention.flash_attention_bwd_
+    block) — no forward re-execution; einsum and ring paths differentiate
+    their forward under jax.vjp (XLA CSEs the duplicated einsum HLO)."""
+    q = jnp.asarray(ins["Q"][0])
+    k = jnp.asarray(ins["K"][0])
+    v = jnp.asarray(ins["V"][0])
+    do = jnp.asarray(ins["Out@GRAD"][0])
+    causal = op_.attr("causal", False)
+    (q, k, v, do), restore = mxu_cast(ctx, q, k, v, do)
+    from ..parallel.ring_attention import (attention_reference,
+                                           ring_attention_sharded)
+    mode, mesh = _sdpa_paths(ctx, op_, q, k, v)
+    if mode == "flash":
+        from . import pallas_attention
+        o = jnp.asarray(ins["Out"][0]).astype(q.dtype)
+        lse = jnp.asarray(ins["LSE"][0])
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1).transpose(0, 2, 1)
+        dq, dk, dv = pallas_attention.flash_attention_bwd_block(
+            q, k, v, do, lse, delta, 0, 0, scale, causal)
+    elif mode == "ring":
+        _, vjp_fn = jax.vjp(
+            lambda a, b, c: ring_attention_sharded(
+                a, b, c, mesh, axis="sp", causal=causal,
+                use_flash=op_.attr("use_flash", False)), q, k, v)
+        dq, dk, dv = vjp_fn(do.astype(q.dtype))
+    else:
+        _, vjp_fn = jax.vjp(
+            lambda a, b, c: attention_reference(a, b, c, causal=causal),
+            q, k, v)
+        dq, dk, dv = vjp_fn(do.astype(q.dtype))
+    if restore is not None:
+        dq, dk, dv = (dq.astype(restore), dk.astype(restore),
+                      dv.astype(restore))
+    outs = {}
+    for name, g in (("Q@GRAD", dq), ("K@GRAD", dk), ("V@GRAD", dv)):
+        if name in op_.desc.outputs:
+            outs[name] = [g]
+    return outs
 
 
 # --- mixture of experts ------------------------------------------------------

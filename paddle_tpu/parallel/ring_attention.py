@@ -26,8 +26,12 @@ from jax import lax
 __all__ = ["attention_reference", "ring_attention", "ring_attention_sharded"]
 
 
-def attention_reference(q, k, v, causal: bool = False, scale=None):
-    """Plain softmax attention, q/k/v [B, T, H, D] -> [B, T, H, D]."""
+def _scaled_masked_logits(q, k, causal, scale):
+    """The one definition of the attention scores [B, H, Tq, Tk]:
+    attention_reference and attention_reference_lse MUST build logits
+    through this single helper — the einsum-path backward's correctness
+    (LSE consistent with the probs) and the XLA-CSE performance story
+    both depend on the two being the identical computation."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -35,8 +39,23 @@ def attention_reference(q, k, v, causal: bool = False, scale=None):
         tq, tk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
         logits = jnp.where(mask, logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, axis=-1)
+    return logits
+
+
+def attention_reference(q, k, v, causal: bool = False, scale=None):
+    """Plain softmax attention, q/k/v [B, T, H, D] -> [B, T, H, D]."""
+    probs = jax.nn.softmax(_scaled_masked_logits(q, k, causal, scale),
+                           axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention_reference_lse(q, k, causal: bool = False, scale=None):
+    """Per-row logsumexp of the scaled (masked) scores [B, H, T] in f32 —
+    the LSE residual the flash kernels save; here derived from the same
+    logits XLA CSEs with attention_reference's einsum."""
+    return jax.scipy.special.logsumexp(
+        _scaled_masked_logits(q, k, causal, scale).astype(jnp.float32),
+        axis=-1)
 
 
 def _block_attn(q, k, v, scale, mask):
