@@ -10,12 +10,14 @@ from .alexnet import alexnet
 from .googlenet import googlenet
 from .mnist import mnist_conv, mnist_mlp
 from .resnet import resnet_cifar10, resnet_imagenet, resnet50
+from .smallnet import smallnet_mnist_cifar
 from .transformer import transformer_lm
 from .vgg import vgg16, vgg19
 from .common import build_image_classifier
 
 __all__ = [
     "alexnet", "googlenet", "mnist_conv", "mnist_mlp",
-    "resnet_cifar10", "resnet_imagenet", "resnet50", "transformer_lm",
+    "resnet_cifar10", "resnet_imagenet", "resnet50",
+    "smallnet_mnist_cifar", "transformer_lm",
     "vgg16", "vgg19", "build_image_classifier",
 ]

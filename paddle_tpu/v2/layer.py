@@ -265,6 +265,153 @@ def trans(input, **kw):
     return fluid_layers.transpose(input, perm=[1, 0])
 
 
+def img_cmrnorm(input, size=5, scale=0.0001, power=0.75, **kw):
+    """Cross-map response normalization (reference img_cmrnorm_layer;
+    AlexNet's LRN). Reference scale is alpha/size."""
+    _split_kw(kw, "img_cmrnorm")
+    return fluid_layers.lrn(input, n=size, alpha=scale, beta=power)
+
+
+def maxout(input, groups, **kw):
+    """(reference maxout_layer)."""
+    _split_kw(kw, "maxout")
+    return fluid_layers.maxout(input, groups=groups)
+
+
+def _check_crf_size(input, size, where):
+    if size is not None and int(input.shape[-1]) != int(size):
+        raise ValueError(
+            f"{where}: size={size} but the feature layer is "
+            f"{input.shape[-1]} wide — the reference crf_layer's size IS "
+            "the tag count, so these must match")
+
+
+def crf(input, label, size=None, param_attr=None, **kw):
+    """Linear-chain CRF training cost (reference crf_layer; size, when
+    given, must equal the feature width = tag count)."""
+    _split_kw(kw, "crf")
+    _check_crf_size(input, size, "crf")
+    return fluid_layers.linear_chain_crf(input=input, label=label,
+                                         param_attr=_as_attr(param_attr))
+
+
+def crf_decoding(input, size=None, label=None, param_attr=None, **kw):
+    """Viterbi decode with the CRF's learned transitions (reference
+    crf_decoding_layer). param_attr must NAME the transition parameter
+    the paired crf() created — decoding reads an existing parameter."""
+    _split_kw(kw, "crf_decoding")
+    _check_crf_size(input, size, "crf_decoding")
+    if param_attr is None:
+        raise ValueError(
+            "crf_decoding needs param_attr naming the transition "
+            "parameter shared with crf() (e.g. param_attr='crf_w' on "
+            "both) — there is no default transition parameter to read")
+    return fluid_layers.crf_decoding(input=input,
+                                     param_attr=_as_attr(param_attr),
+                                     label=label)
+
+
+def ctc(input, label, size=None, blank=0, norm_by_times=False, **kw):
+    """CTC loss over a logit sequence (reference ctc_layer/warp_ctc)."""
+    _split_kw(kw, "ctc")
+    return fluid_layers.warpctc(input=input, label=label, blank=blank,
+                                norm_by_times=norm_by_times)
+
+
+def nce(input, label, num_classes, num_neg_samples=10, param_attr=None,
+        bias_attr=None, **kw):
+    """Noise-contrastive estimation head (reference nce_layer)."""
+    _split_kw(kw, "nce")
+    return fluid_layers.nce(input=input, label=label,
+                            num_total_classes=num_classes,
+                            num_neg_samples=num_neg_samples,
+                            param_attr=_as_attr(param_attr),
+                            bias_attr=_as_attr(bias_attr))
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             **kw):
+    """Hierarchical sigmoid head (reference hsigmoid_layer)."""
+    _split_kw(kw, "hsigmoid")
+    return fluid_layers.hsigmoid(input=input, label=label,
+                                 num_classes=num_classes,
+                                 param_attr=_as_attr(param_attr),
+                                 bias_attr=_as_attr(bias_attr))
+
+
+def bilinear_interp(input, out_size_x, out_size_y, **kw):
+    """Bilinear upsampling (reference bilinear_interp_layer)."""
+    _split_kw(kw, "bilinear_interp")
+    return fluid_layers.bilinear_interp(input, out_h=out_size_y,
+                                        out_w=out_size_x)
+
+
+def roi_pool(input, rois, pooled_width, pooled_height, spatial_scale=1.0,
+             **kw):
+    """(reference roi_pool_layer)."""
+    _split_kw(kw, "roi_pool")
+    return fluid_layers.roi_pool(input=input, rois=rois,
+                                 pooled_height=pooled_height,
+                                 pooled_width=pooled_width,
+                                 spatial_scale=spatial_scale)
+
+
+def interpolation(input, weight, **kw):
+    """w*a + (1-w)*b with a per-row weight in [0,1] (reference
+    interpolation_layer: input = [a, b], weight [N, 1])."""
+    _split_kw(kw, "interpolation")
+    a, b = input
+    wa = fluid_layers.elementwise_mul(a, weight, axis=0)
+    inv = fluid_layers.scale(weight, scale=-1.0, bias=1.0)   # 1 - w
+    wb = fluid_layers.elementwise_mul(b, inv, axis=0)
+    return fluid_layers.elementwise_add(wa, wb)
+
+
+def power(input, weight, **kw):
+    """x ** w elementwise with a per-row exponent (reference
+    power_layer)."""
+    _split_kw(kw, "power")
+    return fluid_layers.elementwise_pow(input, weight, axis=0)
+
+
+def scaling(input, weight, **kw):
+    """Per-row scalar multiply (reference scaling_layer: weight [N, 1])."""
+    _split_kw(kw, "scaling")
+    return fluid_layers.elementwise_mul(input, weight, axis=0)
+
+
+def repeat(input, num_repeats, **kw):
+    """Tile features num_repeats times along the feature axis (reference
+    repeat_layer)."""
+    _split_kw(kw, "repeat")
+    return fluid_layers.concat([input] * num_repeats, axis=-1)
+
+
+def seq_reshape(input, reshape_size, **kw):
+    """Reshape a sequence's step width (reference seq_reshape_layer)."""
+    _split_kw(kw, "seq_reshape")
+    return fluid_layers.sequence_reshape(input, new_dim=reshape_size)
+
+
+def sampling_id(input, **kw):
+    """Sample an id from each row's probability distribution (reference
+    sampling_id_layer). Deterministic argmax fallback is NOT used — draws
+    ride the program's PRNG stream via the uniform_random op. The count
+    is clamped to num_classes-1: f32 cumsum can land slightly below 1.0
+    (or rows may not sum to 1), and a draw above it would otherwise index
+    one past the last class."""
+    _split_kw(kw, "sampling_id")
+    num_classes = int(input.shape[-1])
+    u = fluid_layers.uniform_random_batch_size_like(input, shape=[-1, 1],
+                                                    min=0.0, max=1.0)
+    cum = fluid_layers.cumsum(input, axis=-1)
+    hit = fluid_layers.cast(
+        fluid_layers.less_than(cum, u), "float32")
+    idx = fluid_layers.clip(fluid_layers.reduce_sum(hit, dim=-1),
+                            0.0, float(num_classes - 1))
+    return fluid_layers.cast(idx, "int64")
+
+
 # --- costs -------------------------------------------------------------------
 
 def square_error_cost(input, label):
