@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Linear regression on UCI housing — the canonical first fluid program
+(reference tests/book/test_fit_a_line.py user flow): layers DSL ->
+optimizer.minimize -> Executor over feed/fetch, then save + reload the
+inference model.
+
+Run:  python examples/fluid/train_fit_a_line.py
+(CPU by default; set no env to use the TPU when one is attached.)
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.dataset as dataset
+import paddle_tpu.minibatch as minibatch
+import paddle_tpu.reader as reader
+
+
+def main():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+
+    batched = minibatch.batch(
+        reader.shuffle(dataset.uci_housing.train(), buf_size=500),
+        batch_size=32)
+    feeder = fluid.DataFeeder(place=place, feed_list=[x, y])
+
+    for pass_id in range(10):
+        for data in batched():
+            avg, = exe.run(feed=feeder.feed(data), fetch_list=[loss])
+        print(f"pass {pass_id}: loss {float(np.ravel(avg)[0]):.4f}")
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "fit_a_line.model")
+        fluid.io.save_inference_model(path, ["x"], [pred], exe)
+        prog, feeds, fetches = fluid.io.load_inference_model(path, exe)
+        sample = np.asarray(next(iter(batched()))[0][0],
+                            np.float32).reshape(1, 13)
+        out, = exe.run(prog, feed={"x": sample}, fetch_list=fetches)
+        print("reloaded model predicts", float(np.ravel(out)[0]))
+
+
+if __name__ == "__main__":
+    main()

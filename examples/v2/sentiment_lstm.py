@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Sentiment classification the v2 way — the reference's pre-fluid user
+surface (reference demo style: data layers + networks.simple_lstm +
+SGD event loop + infer), running unchanged over the fluid/XLA stack.
+
+Run:  python examples/v2/sentiment_lstm.py
+"""
+
+import numpy as np
+
+from paddle_tpu import v2 as paddle
+from paddle_tpu.dataset import imdb
+
+
+def main():
+    vocab = len(imdb.word_dict())
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(vocab))
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=64, vocab_size=vocab)
+    lstm = paddle.networks.simple_lstm(input=emb, size=64)
+    pooled = paddle.layer.pooling(lstm, pooling_type=paddle.pooling.Max)
+    logits = paddle.layer.fc(input=pooled, size=2,
+                             act=paddle.activation.Linear)
+    cost = paddle.layer.classification_cost(input=logits, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
+
+    def train_reader():
+        batch = []
+        for i, (ws, lab) in enumerate(imdb.train()()):
+            if i >= 512:
+                break
+            batch.append((ws, [lab]))
+            if len(batch) == 32:
+                yield batch
+                batch = []
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndPass):
+            print(f"pass {event.pass_id} done")
+        elif isinstance(event, paddle.event.EndIteration) and \
+                event.batch_id % 8 == 0:
+            print(f"  batch {event.batch_id}: cost {event.cost:.4f}")
+
+    trainer.train(train_reader, num_passes=3, event_handler=handler,
+                  feeding={"words": 0, "label": 1})
+
+    probe = [([5, 6, 7, 8],), ([3000, 3001, 3002],)]
+    out = np.asarray(paddle.infer(output_layer=logits,
+                                  parameters=parameters, input=probe,
+                                  feeding={"words": 0}))
+    print("inferred logits:", out)
+
+
+if __name__ == "__main__":
+    main()

@@ -265,11 +265,13 @@ def trans(input, **kw):
     return fluid_layers.transpose(input, perm=[1, 0])
 
 
-def img_cmrnorm(input, size=5, scale=0.0001, power=0.75, **kw):
+def img_cmrnorm(input, size=5, scale=0.0128, power=0.75, **kw):
     """Cross-map response normalization (reference img_cmrnorm_layer;
-    AlexNet's LRN). Reference scale is alpha/size."""
+    AlexNet's LRN). The reference config parser divides the user scale by
+    size before it reaches the kernel, so lrn's alpha = scale/size; the
+    reference default scale is 0.0128."""
     _split_kw(kw, "img_cmrnorm")
-    return fluid_layers.lrn(input, n=size, alpha=scale, beta=power)
+    return fluid_layers.lrn(input, n=size, alpha=scale / size, beta=power)
 
 
 def maxout(input, groups, **kw):
@@ -311,9 +313,24 @@ def crf_decoding(input, size=None, label=None, param_attr=None, **kw):
                                      label=label)
 
 
-def ctc(input, label, size=None, blank=0, norm_by_times=False, **kw):
-    """CTC loss over a logit sequence (reference ctc_layer/warp_ctc)."""
+def ctc(input, label, size=None, norm_by_times=False, **kw):
+    """CTC loss over a logit sequence (reference ctc_layer: size = real
+    classes + 1, and the blank is the LAST category index — warp_ctc's
+    blank-0 convention is the sibling warp_ctc_layer, not this one)."""
     _split_kw(kw, "ctc")
+    width = int(input.shape[-1])
+    if size is not None and int(size) != width:
+        raise ValueError(
+            f"ctc: size={size} but the input layer is {width} wide — "
+            "size must be num_classes + 1 (the blank)")
+    return fluid_layers.warpctc(input=input, label=label, blank=width - 1,
+                                norm_by_times=norm_by_times)
+
+
+def warp_ctc(input, label, size=None, blank=0, norm_by_times=False, **kw):
+    """CTC with a selectable blank index (reference warp_ctc_layer:
+    blank defaults to 0)."""
+    _split_kw(kw, "warp_ctc")
     return fluid_layers.warpctc(input=input, label=label, blank=blank,
                                 norm_by_times=norm_by_times)
 
