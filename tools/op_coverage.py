@@ -20,6 +20,11 @@ import sys
 # tunneled) accelerator backend just to read a registry
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# `python tools/op_coverage.py` puts tools/ (not the repo root) on
+# sys.path; make the tool runnable from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
 
 def main(path):
     if not os.path.exists(path):

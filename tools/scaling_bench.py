@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Data-parallel scaling-efficiency benchmark — the measurement apparatus
+for the reference's distributed headline (reference
+benchmark/cluster/vgg16/README.md:40-49: VGG-16 CIFAR-10 over the gRPC
+parameter server scaled at 78.6% efficiency on 20 trainers falling to
+60.9% at 100; BASELINE.md §5 sets >= 90% on ICI as the target this
+design must beat).
+
+Runs the same config (VGG-16, 32x32 inputs, per-device batch 128) over dp
+meshes of growing size and reports samples/sec + efficiency vs linear
+scaling from the 1-device point. On a real TPU slice this measures the
+ICI AllReduce target directly:
+
+    python tools/scaling_bench.py                 # all local devices
+    python tools/scaling_bench.py 1 4 8           # specific mesh sizes
+
+On a CPU host it exercises the identical GSPMD path over virtual devices
+— mechanism check only; the shared core makes the timings say nothing
+about ICI. Use SCALE_PLATFORM=cpu (the env var JAX_PLATFORMS alone does
+not override a TPU plugin) with
+XLA_FLAGS=--xla_force_host_platform_device_count=8, plus
+SCALE_MODEL=smallnet_mnist_cifar SCALE_BS=16 to keep 1-core compiles
+quick.
+
+Prints one JSON line per mesh size plus a summary line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# `python tools/scaling_bench.py` puts tools/ (not the repo root) on
+# sys.path; make the tool runnable from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def measure(n_devices, steps=None, warmup=None, per_device_batch=None):
+    # SCALE_BS/SCALE_STEPS shrink the config for mechanism checks on CPU
+    # hosts (VGG jit compiles cost minutes per mesh size on 1-core boxes);
+    # real-slice measurements should keep the reference bs128
+    if steps is None:
+        steps = int(os.environ.get("SCALE_STEPS", "10"))
+    if warmup is None:
+        warmup = int(os.environ.get("SCALE_WARMUP", "8"))
+    if per_device_batch is None:
+        per_device_batch = int(os.environ.get("SCALE_BS", "128"))
+    if steps < 1 or per_device_batch < 1:
+        raise SystemExit("SCALE_STEPS and SCALE_BS must be >= 1")
+    warmup = max(warmup, 1)   # the sync readback needs at least one run
+    model_name = os.environ.get("SCALE_MODEL", "vgg16")
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as fluid
+    from paddle_tpu import executor as em
+    from paddle_tpu import models
+    from paddle_tpu.framework import unique_name
+
+    batch = per_device_batch * n_devices
+    with unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                    dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            avg_cost, _, _ = models.build_image_classifier(
+                getattr(models, model_name), img, label, class_dim=10)
+            fluid.optimizer.Momentum(learning_rate=0.001,
+                                     momentum=0.9).minimize(
+                avg_cost, startup_program=startup)
+        if n_devices > 1:
+            main._mesh = Mesh(np.array(jax.devices()[:n_devices]), ("dp",))
+
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((batch, 3, 32, 32), dtype=np.float32)
+        y = rng.integers(0, 10, (batch, 1)).astype(np.int64)
+        feed = {"img": jax.device_put(x), "label": jax.device_put(y)}
+        with em.scope_guard(em.Scope()):
+            exe.run(startup)
+            for _ in range(warmup):
+                out, = exe.run(main, feed=feed, fetch_list=[avg_cost],
+                               return_numpy=False)
+            float(np.asarray(out).ravel()[0])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out, = exe.run(main, feed=feed, fetch_list=[avg_cost],
+                               return_numpy=False)
+            final = float(np.asarray(out).ravel()[0])
+            dt = time.perf_counter() - t0
+    assert np.isfinite(final)
+    return batch * steps / dt
+
+
+def main(argv):
+    import jax
+    # SCALE_PLATFORM=cpu forces the host platform for mechanism checks:
+    # in TPU-attached terminals the JAX_PLATFORMS env var alone does not
+    # override the accelerator plugin — only jax.config does
+    plat = os.environ.get("SCALE_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    sizes = sorted({int(a) for a in argv}) or sorted(
+        {1, 2, len(jax.devices())} & set(range(1, len(jax.devices()) + 1)))
+    too_big = [s for s in sizes if s > len(jax.devices())]
+    if too_big:
+        raise SystemExit(
+            f"requested mesh sizes {too_big} exceed the "
+            f"{len(jax.devices())} available devices")
+    results = {}
+    for n in sizes:
+        sps = measure(n)
+        results[n] = sps
+        base = results[min(results)]
+        eff = sps / (base / min(results) * n)
+        print(json.dumps({"devices": n,
+                          "samples_per_sec": round(sps, 2),
+                          "scaling_efficiency": round(eff, 4)}),
+              flush=True)
+    if len(results) > 1:
+        top = max(results)
+        base = results[min(results)]
+        eff = results[top] / (base / min(results) * top)
+        model_name = os.environ.get("SCALE_MODEL", "vgg16")
+        print(json.dumps({
+            "metric": f"{model_name}_dp_scaling_efficiency",
+            "value": round(eff, 4), "unit": "fraction",
+            "devices": top,
+            "vs_baseline": round(eff / 0.6089, 3),  # ref 60.89% @ 100 tr
+        }))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
