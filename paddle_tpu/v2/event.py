@@ -23,3 +23,12 @@ class EndIteration:
         self.batch_id = batch_id
         self.cost = cost
         self.metrics = metrics or {}
+
+
+class TestResult:
+    """Result of SGD.test (reference event.py TestResult: sample-weighted
+    mean cost over the test stream)."""
+
+    def __init__(self, cost, num_samples):
+        self.cost = cost
+        self.num_samples = num_samples

@@ -29,6 +29,7 @@ class SGD:
         self.__cost__ = cost
         self.__parameters__ = parameters
         self.__program__ = cost.block.program
+        self.__test_program__ = None   # built lazily by test(), cached
         update_equation.fluid_optimizer.minimize(cost)
         self.__exe__ = Executor(TPUPlace(0))
         if parameters._scope is not None:
@@ -48,22 +49,33 @@ class SGD:
         for n, val in preloaded.items():
             parameters[n] = val
 
-    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
-        """reader yields per-sample tuples; feeding maps data-layer name ->
-        tuple position (reference trainer.py:137)."""
+    def _feeding_setup(self, feeding, who):
+        """(order, feeder, reorder) shared by train/test — feeding maps
+        data-layer name -> sample tuple position."""
         if not feeding:
-            raise ValueError("v2 SGD.train needs feeding={name: position}")
-        event_handler = event_handler or (lambda e: None)
+            raise ValueError(f"v2 SGD.{who} needs feeding="
+                             "{name: position}")
         block = self.__program__.global_block()
         order = sorted(feeding, key=feeding.get)
         feed_vars = [block.var(n) for n in order]
         feeder = DataFeeder(place=self.__exe__.place, feed_list=feed_vars)
+
+        def reorder(batch):
+            return [tuple(sample[feeding[n]] for n in order)
+                    for sample in batch]
+
+        return feeder, reorder
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        """reader yields per-sample tuples; feeding maps data-layer name ->
+        tuple position (reference trainer.py:137)."""
+        event_handler = event_handler or (lambda e: None)
+        feeder, reorder = self._feeding_setup(feeding, "train")
         with executor_mod.scope_guard(self.__scope__):
             for pass_id in range(num_passes):
                 event_handler(v2_event.BeginPass(pass_id))
                 for batch_id, batch in enumerate(reader()):
-                    batch = [tuple(sample[feeding[n]] for n in order)
-                             for sample in batch]
+                    batch = reorder(batch)
                     event_handler(v2_event.BeginIteration(pass_id, batch_id))
                     cost_v, = self.__exe__.run(
                         self.__program__, feed=feeder.feed(batch),
@@ -71,6 +83,36 @@ class SGD:
                     event_handler(v2_event.EndIteration(
                         pass_id, batch_id, float(np.ravel(cost_v)[0])))
                 event_handler(v2_event.EndPass(pass_id))
+
+    def test(self, reader, feeding=None):
+        """Forward-only evaluation over a batch reader; returns a
+        TestResult with the sample-weighted mean cost (reference
+        trainer.py:217 test — PASS_TEST forward, summed costs)."""
+        feeder, reorder = self._feeding_setup(feeding, "test")
+        if self.__test_program__ is None:
+            # strip + prune + clone(for_test=True): evaluation must not
+            # apply dropout masks, use batch-norm batch statistics, or
+            # write anything back; cached so repeated test() calls reuse
+            # one compiled program (the executor cache keys on identity)
+            from .. import io as io_mod
+            self.__test_program__ = io_mod.get_inference_program(
+                [self.__cost__], self.__program__)
+        total_cost, num_samples = 0.0, 0
+        with executor_mod.scope_guard(self.__scope__):
+            for batch in reader():
+                batch = reorder(batch)
+                cost_v, = self.__exe__.run(
+                    self.__test_program__, feed=feeder.feed(batch),
+                    fetch_list=[self.__cost__])
+                total_cost += float(np.ravel(cost_v)[0]) * len(batch)
+                num_samples += len(batch)
+        if num_samples == 0:
+            raise ValueError(
+                "SGD.test consumed no samples — is the reader a one-shot "
+                "generator that was already exhausted? Pass a factory "
+                "yielding fresh batches per call.")
+        return v2_event.TestResult(cost=total_cost / num_samples,
+                                   num_samples=num_samples)
 
     def save_parameter_to_tar(self, f):
         self.__parameters__.to_tar(f)

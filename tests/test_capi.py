@@ -320,6 +320,57 @@ _CHAPTERS = {
 }
 
 
+class TestCAPIBeamSearchDecode:
+    def test_machine_translation_beam_decode_through_c(self, tmp_path):
+        """The FULL generation-mode decoder — While loop + beam_search +
+        beam_search_decode over trained encoder-decoder params — saved as
+        an inference model and served through the C API (the reference's
+        hardest book inference artifact). Output 0 is the int sentence
+        tensor; ids survive the C float marshaling exactly."""
+        _build_generic()
+        from tests.book import test_machine_translation as mt
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            src, trg, logits = mt.encoder_decoder()
+            label = fluid.layers.data(name="target_language_next_word",
+                                      shape=[1], dtype="int64", lod_level=1)
+            cost = fluid.layers.softmax_with_cross_entropy(
+                logits=logits, label=label, seq_mask=True)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(
+                fluid.layers.mean(cost))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            feeder = fluid.DataFeeder(place=exe.place,
+                                      feed_list=[src, trg, label])
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            exe.run(main, feed=mt._feed(mt._toy_pairs(8, rng), feeder),
+                    fetch_list=[cost])
+
+            dec_main, _dec_start = fluid.Program(), fluid.Program()
+            with fluid.program_guard(dec_main, _dec_start):
+                _s, sentences, scores = mt.decode_program(beam_size=3,
+                                                          use_beam=True)
+            fluid.io.save_inference_model(str(tmp_path), ["src_word_id"],
+                                          [sentences, scores], exe,
+                                          main_program=dec_main)
+        # python-side expectation on the C driver's exact fill pattern
+        lod = [0, 4, 7]
+        plain = _c_ids((7, 1), 0, mt.DICT_SIZE)
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            prog, _f, fetches = fluid.io.load_inference_model(
+                str(tmp_path), exe)
+            want = exe.run(prog,
+                           feed={"src_word_id": executor_mod.LoDTensor(
+                               plain, [lod])},
+                           fetch_list=fetches)[0]
+        got = _run_generic(
+            tmp_path, [_spec("src_word_id", plain, lod=lod,
+                             mod=mt.DICT_SIZE)])
+        np.testing.assert_allclose(got, np.asarray(want, np.float64)
+                                   .reshape(-1), rtol=1e-5, atol=1e-6)
+
+
 class TestCAPIBookChapters:
     """All eight reference book chapters' saved artifacts load and match
     Python through the C API (reference inference/tests/book/*.cc)."""

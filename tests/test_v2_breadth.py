@@ -62,6 +62,29 @@ class TestV2Networks:
                            feeding={"words": 0})
         assert np.asarray(out).shape == (2, 2)
 
+        # SGD.test: forward-only evaluation on held-out data — trained
+        # on separable synthetic imdb, test cost must be low and the
+        # parameters must be untouched by testing
+        from paddle_tpu.dataset import imdb as imdb_mod
+
+        def test_reader():
+            batch = []
+            for i, (ws, lab) in enumerate(imdb_mod.test()()):
+                if i >= 32:
+                    break
+                batch.append((ws, [lab]))
+                if len(batch) == 16:
+                    yield batch
+                    batch = []
+
+        before = {n: parameters[n].copy() for n in parameters.names()}
+        result = trainer.test(test_reader, feeding={"words": 0, "label": 1})
+        assert isinstance(result, paddle.event.TestResult)
+        assert result.num_samples == 32
+        assert result.cost < 0.5, result.cost
+        for n, w in before.items():
+            np.testing.assert_array_equal(parameters[n], w)
+
     def test_img_conv_pool_and_group(self):
         import paddle_tpu as fluid
         img = paddle.layer.data(name="im",
