@@ -30,15 +30,14 @@ def main():
         cost=cost, parameters=parameters,
         update_equation=paddle.optimizer.Adam(learning_rate=5e-3))
 
-    def train_reader():
-        batch = []
-        for i, (ws, lab) in enumerate(imdb.train()()):
-            if i >= 512:
-                break
-            batch.append((ws, [lab]))
-            if len(batch) == 32:
-                yield batch
-                batch = []
+    # the canonical reference composition: batch(shuffle(dataset.train()))
+    train_reader = paddle.batch(
+        paddle.reader.shuffle(
+            paddle.reader.firstn(
+                paddle.reader.map_readers(
+                    lambda s: (s[0], [s[1]]), imdb.train()), 512),
+            buf_size=256),
+        batch_size=32)
 
     def handler(event):
         if isinstance(event, paddle.event.EndPass):

@@ -13,9 +13,11 @@ import random
 import threading
 from typing import Callable, Iterable
 
+from . import creator  # noqa: E402  (reference v2/reader/creator.py)
+
 __all__ = [
     "map_readers", "buffered", "compose", "chain", "shuffle", "firstn",
-    "xmap_readers", "ComposeNotAligned",
+    "xmap_readers", "ComposeNotAligned", "creator",
 ]
 
 

@@ -1,0 +1,54 @@
+"""Reader creators (reference: python/paddle/v2/reader/creator.py —
+np_array, text_file, recordio): factories that turn a data source into a
+sample reader, composing with the decorator chain (shuffle/batch/...)."""
+
+from __future__ import annotations
+
+__all__ = ["np_array", "text_file", "recordio"]
+
+
+def np_array(x):
+    """Reader over the first axis of an ndarray (reference creator.py:22)."""
+    import numpy as np
+
+    arr = np.asarray(x)
+
+    def reader():
+        for row in arr:
+            yield row
+
+    return reader
+
+
+def text_file(path):
+    """Reader yielding stripped lines of a text file (reference
+    creator.py:42)."""
+
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, buf_size=100, decode=False):
+    """Reader over recordio file(s): RAW record bytes, the reference
+    contract (reference creator.py:60 yields f.read(), prefetching
+    buf_size records); here the native chunk reader serves the stream
+    through the buffered decorator. Files written by
+    paddle_tpu.recordio.write_samples hold pickled samples — pass
+    decode=True to get the original objects back."""
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def raw():
+        import pickle
+        from ..recordio import RecordIOScanner
+        for p in paths:
+            with RecordIOScanner(p) as scanner:
+                for rec in scanner:
+                    yield pickle.loads(rec) if decode else rec
+
+    from . import buffered
+    return buffered(raw, buf_size)

@@ -13,6 +13,11 @@ from . import (activation, data_type, evaluator, event, image, layer,
 from .inference import infer
 from .trainer import SGD
 
+# the aliases every reference v2 script leans on:
+#   paddle.batch(paddle.reader.shuffle(paddle.dataset.mnist.train(), ...))
+from .. import dataset, reader
+from ..minibatch import batch
+
 __all__ = ["activation", "data_type", "evaluator", "event", "image",
            "layer", "networks", "optimizer", "parameters", "pooling",
-           "infer", "SGD"]
+           "infer", "SGD", "dataset", "reader", "batch"]

@@ -50,7 +50,7 @@ class SGD:
             parameters[n] = val
 
     def _feeding_setup(self, feeding, who):
-        """(order, feeder, reorder) shared by train/test — feeding maps
+        """(feeder, reorder) shared by train/test — feeding maps
         data-layer name -> sample tuple position."""
         if not feeding:
             raise ValueError(f"v2 SGD.{who} needs feeding="

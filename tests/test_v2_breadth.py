@@ -109,6 +109,37 @@ class TestV2Networks:
         assert bi.shape[-1] == 16 and gru.shape[-1] == 8
 
 
+class TestV2NamespaceAliases:
+    def test_canonical_reader_composition(self):
+        """The composition every reference v2 script opens with:
+        paddle.batch(paddle.reader.shuffle(paddle.dataset.X.train()))."""
+        r = paddle.batch(
+            paddle.reader.shuffle(paddle.dataset.uci_housing.train(),
+                                  buf_size=64), batch_size=8)
+        b = next(iter(r()))
+        assert len(b) == 8 and len(b[0]) == 2
+
+    def test_reader_creators(self):
+        import os
+        import tempfile
+        from paddle_tpu.reader import creator
+        from paddle_tpu.recordio import write_samples
+
+        rows = list(creator.np_array(np.arange(6).reshape(3, 2))())
+        assert [list(r) for r in rows] == [[0, 1], [2, 3], [4, 5]]
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "t.txt")
+            with open(p, "w") as f:
+                f.write("a\nbb\n")
+            assert list(creator.text_file(p)()) == ["a", "bb"]
+            rp = os.path.join(d, "x.recordio")
+            write_samples(rp, [("s", 1), ("t", 2)])
+            assert list(creator.recordio(rp, decode=True)()) == [
+                ("s", 1), ("t", 2)]
+            assert all(isinstance(r, bytes)
+                       for r in creator.recordio(rp)())
+
+
 class TestV2Image:
     def test_simple_transform_train_and_test(self):
         from paddle_tpu.v2 import image as v2_image
