@@ -125,8 +125,10 @@ class While:
         program = self.helper.main_program
         parent_block = program.current_block()
         sub_block = program.create_block()
-        yield
-        program.rollback()
+        try:
+            yield
+        finally:
+            program.rollback()
 
         # reads: consumed names not produced inside; writes: produced names
         # that already exist in the parent chain (loop state)
@@ -168,8 +170,10 @@ class ConditionalBlock:
         program = self.helper.main_program
         parent_block = program.current_block()
         sub_block = program.create_block()
-        yield
-        program.rollback()
+        try:
+            yield
+        finally:
+            program.rollback()
         out_names, produced, reads = [], set(), []
         for op_ in sub_block.ops:
             for n in op_.input_arg_names:
@@ -324,9 +328,11 @@ class _RNNBase:
         self.parent_block = program.current_block()
         self.sub_block = program.create_block()
         self._status = "in"
-        yield
-        self._status = "done"
-        program.rollback()
+        try:
+            yield
+        finally:
+            self._status = "done"
+            program.rollback()
 
         assert self.step_input_vars, "RNN needs step_input()"
         assert all(v is not None for v in self.state_out_vars), (

@@ -34,21 +34,28 @@ def text_file(path):
 
 def recordio(paths, buf_size=100, decode=False):
     """Reader over recordio file(s): RAW record bytes, the reference
-    contract (reference creator.py:60 yields f.read(), prefetching
-    buf_size records); here the native chunk reader serves the stream
-    through the buffered decorator. Files written by
+    contract (reference creator.py:60 yields f.read()). Files written by
     paddle_tpu.recordio.write_samples hold pickled samples — pass
-    decode=True to get the original objects back."""
+    decode=True to get the original objects back (delegates to
+    recordio.read_samples, the one scan-and-unpickle implementation).
+
+    buf_size is accepted for reference source compatibility but not
+    applied here: the buffered decorator's prefetch thread would leak
+    (parked on a full queue, scanner handle open) whenever a consumer
+    abandons the stream early — compose `reader.buffered(r, n)`
+    explicitly when prefetch is wanted and the stream is fully drained.
+    A generator here means abandonment closes the scanner promptly
+    (GeneratorExit unwinds the with-block)."""
     if isinstance(paths, str):
         paths = paths.split(",")
 
-    def raw():
-        import pickle
-        from ..recordio import RecordIOScanner
+    def reader():
+        from .. import recordio as recordio_mod
         for p in paths:
-            with RecordIOScanner(p) as scanner:
-                for rec in scanner:
-                    yield pickle.loads(rec) if decode else rec
+            if decode:
+                yield from recordio_mod.read_samples(p)
+            else:
+                with recordio_mod.RecordIOScanner(p) as scanner:
+                    yield from scanner
 
-    from . import buffered
-    return buffered(raw, buf_size)
+    return reader

@@ -14,10 +14,31 @@ from .inference import infer
 from .trainer import SGD
 
 # the aliases every reference v2 script leans on:
+#   paddle.init(use_gpu=False, trainer_count=1)
 #   paddle.batch(paddle.reader.shuffle(paddle.dataset.mnist.train(), ...))
 from .. import dataset, reader
 from ..minibatch import batch
 
+_init_kwargs = {}
+
+
+def init(**kwargs):
+    """Runtime bring-up (reference paddle.init -> swig initPaddle +
+    gflags). The XLA stack needs no explicit initialization — device
+    selection happens at Executor construction, and TPUPlace falls back
+    to CPU when no accelerator exists — so this records the flags for
+    introspection and validates the ones with no analogue here."""
+    _init_kwargs.update(kwargs)
+    tc = int(kwargs.get("trainer_count", 1) or 1)
+    if tc > 1:
+        import warnings
+        warnings.warn(
+            "paddle.init(trainer_count>1): the v2 multi-thread trainer "
+            "is subsumed by SPMD sharding — tag the program with a mesh "
+            "(see paddle_tpu.parallel) instead; running single-replica.",
+            stacklevel=2)
+
+
 __all__ = ["activation", "data_type", "evaluator", "event", "image",
            "layer", "networks", "optimizer", "parameters", "pooling",
-           "infer", "SGD", "dataset", "reader", "batch"]
+           "infer", "SGD", "dataset", "reader", "batch", "init"]

@@ -70,10 +70,11 @@ def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None,
     forwarded — v2 code names parameters for sharing and decode-time reuse
     (ADVICE r3: silently dropping them broke that)."""
     _split_kw(kw, "fc")
-    return fluid_layers.fc(input=input, size=size, act=_act_name(act),
-                           param_attr=_as_attr(param_attr),
-                           bias_attr=_as_attr(bias_attr), name=name,
-                           num_flatten_dims=num_flatten_dims)
+    return _register_named(name, fluid_layers.fc(
+        input=input, size=size, act=_act_name(act),
+        param_attr=_as_attr(param_attr),
+        bias_attr=_as_attr(bias_attr), name=name,
+        num_flatten_dims=num_flatten_dims))
 
 
 def embedding(input, size, param_attr=None, **kw):
@@ -171,6 +172,88 @@ def recurrent(input, act=None, reverse=False, **kw):
                                     is_reverse=reverse)
 
 
+# --- recurrent group ---------------------------------------------------------
+
+class _RecurrentCtx:
+    def __init__(self, rnn):
+        self.rnn = rnn
+        self.named = {}          # layers created with name= inside the step
+        self.memories = []       # (name, mem_var)
+
+
+_RG_STACK = []
+
+
+def _register_named(name, var):
+    """Step layers created with name= become memory-update targets
+    (reference recurrent_group wires memory(name=N) to the step layer
+    named N)."""
+    if name is not None and _RG_STACK:
+        _RG_STACK[-1].named[name] = var
+    return var
+
+
+def memory(name, size=None, boot_layer=None, **kw):
+    """Previous-step value of the step layer called `name` (reference
+    memory layer). Only meaningful inside recurrent_group's step; boots
+    from boot_layer when given, else zeros of [size]."""
+    _split_kw(kw, "memory")
+    if not _RG_STACK:
+        raise ValueError("memory() must be called inside a "
+                         "recurrent_group step function")
+    ctx = _RG_STACK[-1]
+    if boot_layer is not None:
+        mem = ctx.rnn.memory(init=boot_layer)
+    else:
+        if size is None:
+            raise ValueError("memory() needs size= (or boot_layer=)")
+        mem = ctx.rnn.memory(shape=[size])
+    ctx.memories.append((name, mem))
+    return mem
+
+
+def recurrent_group(step, input, reverse=False, **kw):
+    """Custom recurrence over sequence input(s) (reference
+    recurrent_group, the v2 surface of RecurrentGradientMachine;
+    reference gserver/gradientmachines/RecurrentGradientMachine.h:32).
+    `step` receives per-step slices of each sequence input; inside it,
+    memory(name=N, ...) reads the previous step's layer named N — create
+    that layer with name=N (fc/addto/... forward name into the group's
+    registry). Lowered onto fluid DynamicRNN -> lax.scan.
+
+    Supported subset: sequence inputs (plain Variables), zero- or
+    layer-booted memories, single or multiple step outputs. The
+    proto-era extras (StaticInput, GeneratedInput inside beam decode)
+    stay on the fluid DynamicRNN/beam_search surface."""
+    _split_kw(kw, "recurrent_group")
+    if reverse:
+        # pure argument check: raise BEFORE any graph construction
+        raise NotImplementedError(
+            "recurrent_group(reverse=True): feed a reversed sequence or "
+            "use lstmemory/grumemory(reverse=True)")
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    rnn = fluid_layers.DynamicRNN()
+    ctx = _RecurrentCtx(rnn)
+    with rnn.block():
+        _RG_STACK.append(ctx)
+        try:
+            step_ins = [rnn.step_input(x) for x in inputs]
+            out = step(*step_ins)
+        finally:
+            _RG_STACK.pop()
+        for name, mem in ctx.memories:
+            tgt = ctx.named.get(name)
+            if tgt is None:
+                raise ValueError(
+                    f"recurrent_group: memory('{name}') has no step "
+                    f"layer named '{name}' to carry from — create it "
+                    f"with name='{name}'")
+            rnn.update_memory(mem, tgt)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        rnn.output(*outs)
+    return rnn()
+
+
 # --- sequence ops ------------------------------------------------------------
 
 def last_seq(input):
@@ -222,7 +305,7 @@ def concat(input, **kw):
     return fluid_layers.concat(input, axis=1)
 
 
-def addto(input, act=None, bias_attr=None, **kw):
+def addto(input, act=None, bias_attr=None, name=None, **kw):
     """Elementwise sum of N inputs (reference addto_layer)."""
     _split_kw(kw, "addto")
     inputs = input if isinstance(input, (list, tuple)) else [input]
@@ -232,7 +315,7 @@ def addto(input, act=None, bias_attr=None, **kw):
     act = _act_name(act)
     if act:
         out = getattr(fluid_layers, act)(out)
-    return out
+    return _register_named(name, out)
 
 
 def dotmul_operator(a, b, scale=1.0):

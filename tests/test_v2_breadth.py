@@ -174,6 +174,74 @@ class TestV2Image:
             im, 2, rng=np.random.RandomState(0)).shape == (2, 2)
 
 
+class TestV2RecurrentGroup:
+    def test_vanilla_rnn_matches_manual_recurrence(self):
+        """recurrent_group with a named-memory fc step (the reference's
+        canonical custom-RNN shape) vs a numpy recurrence oracle."""
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod
+
+        H, D, vocab = 4, 3, 20
+        seq = paddle.layer.data(
+            name="sq3", type=paddle.data_type.integer_value_sequence(vocab))
+        emb = paddle.layer.embedding(input=seq, size=D, vocab_size=vocab,
+                                     param_attr="rg_emb")
+
+        def step(x_t):
+            prev = paddle.layer.memory(name="h", size=H)
+            h = paddle.layer.fc(input=[x_t, prev], size=H,
+                                act=paddle.activation.Tanh(),
+                                param_attr="rg_w", bias_attr="rg_b",
+                                name="h")
+            return h
+
+        out = paddle.layer.recurrent_group(step=step, input=emb)
+        last = paddle.layer.last_seq(out)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = executor_mod.Scope()
+        with executor_mod.scope_guard(sc):
+            exe.run(fluid.framework.framework.default_startup_program())
+            LoD = executor_mod.LoDTensor
+            ids = np.array([[1], [2], [3], [7], [8]], np.int64)
+            feed = {"sq3": LoD(ids, [[0, 3, 5]])}
+            got, = exe.run(
+                fluid.framework.framework.default_main_program(),
+                feed=feed, fetch_list=[last])
+            emb_w = np.asarray(sc.find_var("rg_emb"))
+            # fc over [x_t, prev]: first weight keeps the given name, the
+            # second replica gets a generated one (reference
+            # multiple_param_attr semantics) — find it by shape [H, H]
+            w = np.asarray(sc.find_var("rg_w"))
+            b = np.asarray(sc.find_var("rg_b"))
+            w2_name, = [n for n in sc.local_var_names()
+                        if n not in ("rg_w", "rg_b", "rg_emb")
+                        and getattr(sc.find_var(n), "shape", None) == (H, H)]
+            w2 = np.asarray(sc.find_var(w2_name))
+
+        def run_seq(token_ids):
+            h = np.zeros(H, np.float32)
+            for t in token_ids:
+                x = emb_w[t]
+                h = np.tanh(x @ w + h @ w2 + b)
+            return h
+
+        want = np.stack([run_seq([1, 2, 3]), run_seq([7, 8])])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_memory_without_named_target_raises(self):
+        emb = paddle.layer.data(name="sq4",
+                                type=paddle.data_type.dense_vector(4))
+
+        def bad_step(x_t):
+            prev = paddle.layer.memory(name="nope", size=4)
+            return paddle.layer.fc(input=[x_t, prev], size=4)  # unnamed
+
+        with pytest.raises(ValueError, match="nope"):
+            paddle.layer.recurrent_group(step=bad_step, input=emb)
+
+
 class TestV2Evaluator:
     def test_classification_error(self):
         import paddle_tpu as fluid
