@@ -15,11 +15,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE = os.path.join(REPO, "paddle_tpu", "native")
 
 
-def _build():
+def _build_lib():
     r = subprocess.run(["make", "-s", "-C", NATIVE, "libpaddle_tpu_capi.so"],
                        capture_output=True, text=True)
     if r.returncode != 0:
         pytest.skip(f"capi build unavailable: {r.stderr[-500:]}")
+
+
+def _build():
+    _build_lib()
     r = subprocess.run(
         ["gcc", os.path.join(REPO, "examples/capi/infer_fit_a_line.c"),
          "-I", NATIVE, "-L", NATIVE, "-lpaddle_tpu_capi",
@@ -318,6 +322,99 @@ _CHAPTERS = {
     "label_semantic_roles": _ch_label_semantic_roles,
     "rnn_encoder_decoder": _ch_rnn_encoder_decoder,
 }
+
+
+class TestCAPIErrorPaths:
+    """The C surface must fail with TYPED error codes, not crashes
+    (reference paddle_error contract, capi/error.h)."""
+
+    def _lib(self):
+        import ctypes
+        _build_lib()   # the shared lib only — no example binary needed
+        # PyDLL, not CDLL: these calls re-enter the ALREADY-RUNNING
+        # interpreter (capi.cc embeds CPython); CDLL would release the
+        # GIL around the foreign call and the embedded import would run
+        # GIL-less and crash
+        lib = ctypes.PyDLL(os.path.join(NATIVE, "libpaddle_tpu_capi.so"))
+        lib.paddle_tpu_machine_create.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_char_p]
+        return lib, ctypes
+
+    def test_create_from_missing_dir_is_typed_error(self, tmp_path):
+        lib, ctypes = self._lib()
+        assert lib.paddle_tpu_init() == 0
+        h = ctypes.c_void_p()
+        rc = lib.paddle_tpu_machine_create(
+            ctypes.byref(h), str(tmp_path / "nope").encode())
+        assert rc == 3, rc       # PD_PROTOBUF_ERROR: artifact unreadable
+
+    def test_null_arguments_rejected(self):
+        lib, ctypes = self._lib()
+        assert lib.paddle_tpu_machine_create(None, b"x") == 1  # PD_NULLPTR
+        assert lib.paddle_tpu_machine_destroy(None) == 1
+        assert lib.paddle_tpu_machine_forward(None) == 1
+
+    def test_bad_input_name_and_missing_feed(self, tmp_path):
+        lib, ctypes = self._lib()
+        # a real model to open
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            pred = fluid.layers.fc(input=x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(startup)
+            fluid.io.save_inference_model(str(tmp_path), ["x"], [pred],
+                                          exe, main_program=main)
+        assert lib.paddle_tpu_init() == 0
+        h = ctypes.c_void_p()
+        assert lib.paddle_tpu_machine_create(
+            ctypes.byref(h), str(tmp_path).encode()) == 0
+        dims = (ctypes.c_int64 * 2)(1, 4)
+        buf = (ctypes.c_float * 4)(1, 2, 3, 4)
+        # wrong feed name -> error, not crash
+        rc = lib.paddle_tpu_machine_set_input(h, b"not_a_feed", buf, dims, 2)
+        assert rc != 0
+        # forward without staging the real input -> error
+        assert lib.paddle_tpu_machine_forward(h) != 0
+        # stage correctly -> forward succeeds
+        assert lib.paddle_tpu_machine_set_input(h, b"x", buf, dims, 2) == 0
+        assert lib.paddle_tpu_machine_forward(h) == 0
+        assert lib.paddle_tpu_machine_destroy(h) == 0
+
+    def test_bad_lod_offsets_rejected(self, tmp_path):
+        lib, ctypes = self._lib()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            w = fluid.layers.data(name="w", shape=[1], dtype="int64",
+                                  lod_level=1)
+            emb = fluid.layers.embedding(input=w, size=[10, 4])
+            pooled = fluid.layers.sequence_pool(emb, "sum")
+            pred = fluid.layers.fc(input=pooled, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(startup)
+            fluid.io.save_inference_model(str(tmp_path), ["w"], [pred],
+                                          exe, main_program=main)
+        assert lib.paddle_tpu_init() == 0
+        h = ctypes.c_void_p()
+        assert lib.paddle_tpu_machine_create(
+            ctypes.byref(h), str(tmp_path).encode()) == 0
+        ids = (ctypes.c_int64 * 3)(1, 2, 3)
+        dims = (ctypes.c_int64 * 2)(3, 1)
+        assert lib.paddle_tpu_machine_set_input_typed(
+            h, b"w", ids, 1, dims, 2) == 0
+        # non-monotonic offsets -> PD_OUT_OF_RANGE before touching python
+        bad = (ctypes.c_int64 * 3)(0, 2, 1)
+        assert lib.paddle_tpu_machine_set_input_lod(h, b"w", bad, 3) == 2
+        # offsets not ending at the row count -> error from the backend
+        short = (ctypes.c_int64 * 2)(0, 2)
+        assert lib.paddle_tpu_machine_set_input_lod(h, b"w", short, 2) != 0
+        # correct offsets work end to end
+        good = (ctypes.c_int64 * 3)(0, 2, 3)
+        assert lib.paddle_tpu_machine_set_input_lod(h, b"w", good, 3) == 0
+        assert lib.paddle_tpu_machine_forward(h) == 0
+        assert lib.paddle_tpu_machine_destroy(h) == 0
 
 
 class TestCAPIBeamSearchDecode:
