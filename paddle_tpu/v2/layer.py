@@ -212,6 +212,25 @@ def memory(name, size=None, boot_layer=None, **kw):
     return mem
 
 
+class StaticInput:
+    """Non-sequence input to recurrent_group: the SAME variable is visible
+    at every step (reference StaticInput — the seq2seq demos pass the
+    encoded source this way). The sub-block reads parent-block variables
+    directly, so this is a pass-through marker."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        if is_seq:
+            raise NotImplementedError(
+                "StaticInput(is_seq=True): pass sequence inputs to "
+                "recurrent_group directly instead")
+        if getattr(input, "lod_level", 0):
+            raise ValueError(
+                "StaticInput got a SEQUENCE variable (lod_level>0) — a "
+                "static input is one vector per batch row; pass sequences "
+                "to recurrent_group directly (or pool them first)")
+        self.input = input
+
+
 def recurrent_group(step, input, reverse=False, **kw):
     """Custom recurrence over sequence input(s) (reference
     recurrent_group, the v2 surface of RecurrentGradientMachine;
@@ -221,10 +240,10 @@ def recurrent_group(step, input, reverse=False, **kw):
     that layer with name=N (fc/addto/... forward name into the group's
     registry). Lowered onto fluid DynamicRNN -> lax.scan.
 
-    Supported subset: sequence inputs (plain Variables), zero- or
-    layer-booted memories, single or multiple step outputs. The
-    proto-era extras (StaticInput, GeneratedInput inside beam decode)
-    stay on the fluid DynamicRNN/beam_search surface."""
+    Supported subset: sequence inputs (plain Variables), StaticInput
+    (same variable every step), zero- or layer-booted memories, single
+    or multiple step outputs. GeneratedInput (decode-time) stays on the
+    fluid DynamicRNN/beam_search surface."""
     _split_kw(kw, "recurrent_group")
     if reverse:
         # pure argument check: raise BEFORE any graph construction
@@ -232,12 +251,16 @@ def recurrent_group(step, input, reverse=False, **kw):
             "recurrent_group(reverse=True): feed a reversed sequence or "
             "use lstmemory/grumemory(reverse=True)")
     inputs = input if isinstance(input, (list, tuple)) else [input]
+    if all(isinstance(x, StaticInput) for x in inputs):
+        raise ValueError("recurrent_group needs at least one sequence "
+                         "input (only StaticInputs given)")
     rnn = fluid_layers.DynamicRNN()
     ctx = _RecurrentCtx(rnn)
     with rnn.block():
         _RG_STACK.append(ctx)
         try:
-            step_ins = [rnn.step_input(x) for x in inputs]
+            step_ins = [x.input if isinstance(x, StaticInput)
+                        else rnn.step_input(x) for x in inputs]
             out = step(*step_ins)
         finally:
             _RG_STACK.pop()

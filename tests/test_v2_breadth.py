@@ -230,6 +230,41 @@ class TestV2RecurrentGroup:
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
                                    atol=1e-6)
 
+    def test_static_input_visible_every_step(self):
+        """StaticInput: the same per-batch vector joins every step's
+        computation (the reference seq2seq pattern for the encoded
+        source)."""
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod
+
+        seq = paddle.layer.data(name="sq5",
+                                type=paddle.data_type.dense_vector_sequence(2))
+        ctxv = paddle.layer.data(name="cx5",
+                                 type=paddle.data_type.dense_vector(2))
+
+        def step(x_t, c):
+            prev = paddle.layer.memory(name="acc", size=2)
+            s = paddle.layer.addto([x_t, c, prev], name="acc")
+            return s
+
+        out = paddle.layer.recurrent_group(
+            step=step, input=[seq, paddle.layer.StaticInput(ctxv)])
+        last = paddle.layer.last_seq(out)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(fluid.framework.framework.default_startup_program())
+            LoD = executor_mod.LoDTensor
+            x = np.array([[1, 1], [2, 2], [10, 10]], np.float32)
+            feed = {"sq5": LoD(x, [[0, 2, 3]]),
+                    "cx5": np.array([[0.5, 0.5], [3.0, 3.0]], np.float32)}
+            got, = exe.run(
+                fluid.framework.framework.default_main_program(),
+                feed=feed, fetch_list=[last])
+        # seq1: (1+.5) then +(2+.5) = 4; seq2: 10+3 = 13 — the static
+        # vector is added at EVERY step
+        np.testing.assert_allclose(np.asarray(got),
+                                   [[4.0, 4.0], [13.0, 13.0]], rtol=1e-6)
+
     def test_memory_without_named_target_raises(self):
         emb = paddle.layer.data(name="sq4",
                                 type=paddle.data_type.dense_vector(4))
