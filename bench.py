@@ -23,6 +23,16 @@ BENCH_MODE selects the config family:
   smallnet           SmallNet (CIFAR-quick) train, vs 8122 img/s (§1 bs512)
   transformer        transformer-LM train step with use_flash attention
                      (models/transformer.py), tokens/sec + MFU
+  ring_attention     transformer-LM T=32k train step, flash ring over an
+                     'sp' mesh of all visible devices; vs the r4 1.58 s/step
+                     regression anchor
+
+Resilience (VERDICT r4 #1): every mode retries transient tunnel/compile
+failures (bounded, BENCH_RETRIES), keeps completed timing chunks, and the
+top level ALWAYS prints the JSON line — on persistent failure with
+value=null plus an `errors` log, so the driver's parse never comes back
+empty. Every mode also reports the session's sustained-TF/s roofline and
+MFU against both nominal peak and that roofline (BENCH_ROOFLINE=0 skips).
 """
 
 import json
@@ -33,6 +43,9 @@ import time
 import numpy as np
 
 BATCH = os.environ.get("BENCH_BATCH")
+# bounded retry budget for transient tunnel/compile failures (r4 lost its
+# official number to a single `remote_compile: response body closed`)
+RETRIES = int(os.environ.get("BENCH_RETRIES", "4"))
 STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 # the tunneled TPU terminal runs the first ~20 executions of a fresh
 # executable slow (program caching); warm past that to measure steady state
@@ -118,19 +131,187 @@ def _feeds(exe, batch, shapes_dtypes, rng):
         reader, device=exe.device if host_uploads else None, capacity=1))
 
 
-def _timed_loop(run_step, warmup, steps):
-    """Warm, then time `steps` back-to-back enqueues with one final sync.
-    run_step() must return an on-device scalar (return_numpy=False)."""
-    for _ in range(max(warmup, 1)):
-        out = run_step()
-    float(np.asarray(out).ravel()[0])  # sync
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = run_step()
-    final = float(np.asarray(out).ravel()[0])  # sync on the last step
-    dt = time.perf_counter() - t0
-    assert np.isfinite(final)
-    return dt
+_TRANSIENT_MARKERS = (
+    "INTERNAL", "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+    "remote_compile", "response body closed", "Connection reset",
+    "Connection closed", "connection", "Broken pipe", "Socket closed",
+    "timed out", "Timeout", "EOF", "RESOURCE_EXHAUSTED",
+)
+
+
+def _is_transient(e):
+    """Transient infra failure (tunnel hiccup, remote-compile drop) vs a
+    real bug. Assertion failures (NaN loss guards) are never transient;
+    runtime-flavored errors and anything matching the marker list are —
+    retries are bounded, so over-matching costs seconds, under-matching
+    costs the round its official number (VERDICT r4 weak #1)."""
+    if isinstance(e, (AssertionError, KeyboardInterrupt, SystemExit,
+                      TypeError, NameError, AttributeError)):
+        return False
+    s = f"{type(e).__name__}: {e}"
+    return ("RuntimeError" in type(e).__name__
+            or any(m in s for m in _TRANSIENT_MARKERS))
+
+
+class BenchError(RuntimeError):
+    """Persistent failure after the retry budget; carries the error log."""
+
+    def __init__(self, errors):
+        super().__init__(errors[-1] if errors else "bench failed")
+        self.errors = list(errors)
+
+
+def _retrying(phase, fn, errors):
+    """Call fn(), retrying transient failures up to BENCH_RETRIES times
+    with linear backoff; every failure is logged into `errors`. Raises the
+    original exception on a non-transient error or budget exhaustion."""
+    attempts = RETRIES + 1
+    for a in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - classified below
+            errors.append(f"{phase}: {type(e).__name__}: {e}"[:300])
+            if a == attempts - 1 or not _is_transient(e):
+                raise
+            time.sleep(min(2.0 * (a + 1), 10.0))
+    return None
+
+
+def _timed_loop(run_step, warmup, steps, errors=None):
+    """Warm, then time back-to-back enqueues in chunks with one sync per
+    chunk. run_step() must return an on-device scalar (return_numpy=False).
+
+    Resilient (VERDICT r4 #1): every phase retries transient failures up to
+    BENCH_RETRIES times — re-invoking run_step() re-triggers compilation,
+    which is where the r4 tunnel drop hit — and completed timing chunks are
+    kept, so one late hiccup still yields a number from the steps that did
+    run. Non-transient failures (the NaN-loss assertion guard) always
+    propagate — a diverged run must never be reported as a partial success.
+    Returns (dt_seconds, steps_timed); appends messages to `errors`.
+    Chunking (default 2) barely perturbs the measurement: enqueues still
+    pipeline within a chunk and the per-chunk sync is one scalar readback.
+    """
+    errors = errors if errors is not None else []
+
+    def _warm():
+        out = None
+        for _ in range(max(warmup, 1)):
+            out = run_step()
+        float(np.asarray(out).ravel()[0])  # sync
+
+    try:
+        _retrying("warmup", _warm, errors)
+    except Exception as e:
+        if not _is_transient(e):
+            raise
+        raise BenchError(errors) from e
+
+    chunks = max(1, int(os.environ.get("BENCH_CHUNKS", "2")))
+    per = max(1, steps // chunks)
+    dt, done = 0.0, 0
+
+    def _chunk():
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(per):
+            out = run_step()
+        final = float(np.asarray(out).ravel()[0])  # sync
+        elapsed = time.perf_counter() - t0
+        assert np.isfinite(final), f"non-finite fetch {final}"
+        return elapsed
+
+    while done < steps:
+        try:
+            dt += _retrying("timed", _chunk, errors)
+            done += per
+        except Exception as e:
+            if not _is_transient(e):
+                raise  # real bug (e.g. NaN): never report a partial number
+            if done:
+                break  # partial result from completed chunks
+            raise BenchError(errors) from e
+    return dt, done
+
+
+_ROOFLINE = None
+
+
+def _roofline_cached():
+    """Same-session sustained bf16 matmul TF/s (VERDICT r4 #3).
+
+    A jitted lax.scan of data-dependent [n,n] bf16 matmuls (each depends on
+    the previous, so the chain cannot be elided or reordered) with a scalar
+    readback as the fence — `block_until_ready` does not actually block on
+    the tunneled terminal (measured r3). Best-of-3 rounds of back-to-back
+    calls, because the tunnel drifts run-to-run. The result is the honest
+    MFU denominator: nominal peak (197 TF/s v5e) is the datasheet; what the
+    session's chip+tunnel actually sustains is what a program can use."""
+    global _ROOFLINE
+    if _ROOFLINE is not None:
+        return _ROOFLINE or None
+    if os.environ.get("BENCH_ROOFLINE", "1") != "1":
+        _ROOFLINE = False
+        return None
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        n = int(os.environ.get("BENCH_ROOFLINE_N", "4096"))
+        iters, calls = 16, 10
+        rng = np.random.default_rng(0)
+        scale = 1.0 / np.sqrt(n)  # variance-preserving: no bf16 overflow
+        w = jnp.asarray(rng.standard_normal((n, n)) * scale, jnp.bfloat16)
+        x = jnp.asarray(rng.standard_normal((n, n)) * scale, jnp.bfloat16)
+
+        @jax.jit
+        def chain(x, w):
+            y, _ = lax.scan(lambda c, _: (c @ w, None), x, None,
+                            length=iters)
+            return (y[0, 0]).astype(jnp.float32)
+
+        for _ in range(25):  # fresh executables run slow ~20 times here
+            out = chain(x, w)
+        float(out)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                out = chain(x, w)
+            float(out)  # device runs are ordered: last sync fences all
+            best = min(best, time.perf_counter() - t0)
+        tflops = 2.0 * iters * n ** 3 * calls / best / 1e12
+        _ROOFLINE = {"tflops": round(tflops, 2), "n": n}
+    except Exception as e:  # noqa: BLE001 - probe must never kill the bench
+        _ROOFLINE = False
+        sys.stderr.write(f"roofline probe failed: {e}\n")
+        return None
+    return _ROOFLINE
+
+
+_CARRIED_ERRORS = []  # errors from a failed whole-family attempt (main())
+
+
+def _emit(payload, errors=()):
+    """Print the ONE JSON line the driver parses. Attaches the retry error
+    log and the session roofline (sustained TF/s + MFU against it) so a
+    partial or degraded run is visible but still parseable."""
+    allerr = _CARRIED_ERRORS + list(errors)
+    if allerr:
+        payload["errors"] = allerr
+    # never run the device probe on the persistent-failure path: a wedged
+    # tunnel hangs rather than raises, and the guaranteed JSON line must
+    # still come out
+    probe = None if payload.get("value") is None else _roofline_cached()
+    if probe:
+        payload["sustained_tflops"] = probe["tflops"]
+        mfu = payload.get("mfu")
+        if mfu is not None and probe["tflops"] > 0:
+            payload["mfu_nominal"] = mfu
+            payload["mfu_vs_sustained"] = round(
+                mfu * PEAK_TFLOPS / probe["tflops"], 4)
+    print(json.dumps(payload))
+    sys.stdout.flush()
 
 
 def main_cnn(family, train=True):
@@ -181,13 +362,14 @@ def main_cnn(family, train=True):
                        return_numpy=False)
         return out
 
-    dt = _timed_loop(step, WARMUP, STEPS)
-    img_s = batch * STEPS / dt
+    errors = []
+    dt, done = _timed_loop(step, WARMUP, STEPS, errors)
+    img_s = batch * done / dt
     flops_per_img = (3 if train else 1) * cfg["fwd_flops"]
     mfu = img_s * flops_per_img / (PEAK_TFLOPS * 1e12)
     base = cfg["train_base"] if train else cfg["infer_base"]
     job = "train" if train else "infer"
-    print(json.dumps({
+    _emit({
         "metric": f"{cfg['builder']}_{job}_images_per_sec",
         "value": round(img_s, 2),
         "unit": "images/sec",
@@ -195,8 +377,9 @@ def main_cnn(family, train=True):
         "batch": batch,
         "amp": AMP if train else False,
         "amp_level": (AMP_LEVEL if AMP else None) if train else None,
+        "steps_timed": done,
         "mfu": round(mfu, 4),
-    }))
+    }, errors)
 
 
 def main_lstm():
@@ -247,22 +430,24 @@ def main_lstm():
                         return_numpy=False)
         return loss
 
-    dt = _timed_loop(step, warmup, steps)
-    ms_batch = dt / steps * 1000
+    errors = []
+    dt, done = _timed_loop(step, warmup, steps, errors)
+    ms_batch = dt / done * 1000
     # fwd FLOPs/batch: input projections (emb->4H, H->4H) + recurrent gemm
     # (H->4H per step) for both layers; train step ~ 3x forward
     gemm = (emb_dim * 4 * hid + hid * 4 * hid    # layer1 proj + recur
             + hid * 4 * hid + hid * 4 * hid)     # layer2 proj + recur
     fwd_flops = 2 * bsz * seqlen * gemm
-    mfu = 3 * fwd_flops / (dt / steps) / (PEAK_TFLOPS * 1e12)
-    print(json.dumps({
+    mfu = 3 * fwd_flops / (dt / done) / (PEAK_TFLOPS * 1e12)
+    _emit({
         "metric": "lstm2_h512_train_ms_per_batch",
         "value": round(ms_batch, 2),
         "unit": "ms/batch",
         "vs_baseline": round(baseline_ms / ms_batch, 3),
         "batch": bsz, "seqlen": seqlen, "hidden": hid,
+        "steps_timed": done,
         "mfu": round(mfu, 4),
-    }))
+    }, errors)
 
 
 def main_attention():
@@ -306,27 +491,43 @@ def main_attention():
     # BENCH_ATTN_XLA=0 skips the einsum side entirely — at long T its
     # [T, T] residuals exhaust HBM, which is exactly flash's point
     run_xla = os.environ.get("BENCH_ATTN_XLA", "1") == "1"
-    for g in ((g_flash, g_xla) if run_xla else (g_flash,)):
+    errors = []
+
+    def _retry(phase, fn):
+        return _retrying(phase, fn, errors)
+
+    def _warm(g):
+        r = None
         for _ in range(warmup):          # warm past the program cache
             r = g(q, k, v)
         float(np.asarray(r[0]).ravel()[0])
+
+    for g in ((g_flash, g_xla) if run_xla else (g_flash,)):
+        _retry("warmup", lambda g=g: _warm(g))
     # the tunneled chip drifts run-to-run (r3: high variance); alternate
     # measurement rounds and take each side's best so drift hits both
     flash_ts, xla_ts = [], []
     for _ in range(3):
-        flash_ts.append(time_once(g_flash, steps))
+        flash_ts.append(_retry("flash", lambda: time_once(g_flash, steps)))
         if run_xla:
-            xla_ts.append(time_once(g_xla, steps))
+            xla_ts.append(_retry("xla", lambda: time_once(g_xla, steps)))
     flash_s = min(flash_ts)
     xla_s = min(xla_ts) if run_xla else None
-    print(json.dumps({
+    _emit({
         "metric": f"flash_attention_fwd_bwd_ms_T{t}_causal",
         "value": round(flash_s * 1e3, 3),
         "unit": "ms/step",
         "vs_baseline": round(xla_s / flash_s, 3) if run_xla else None,
         "xla_reference_ms": round(xla_s * 1e3, 3) if run_xla else None,
         "shape": [b, t, h, d],
-    }))
+    }, errors)
+
+
+def _transformer_flops_per_token(n_layer, d_model, seqlen, vocab):
+    """Forward FLOPs/token: per layer 2*(attn qkvo 4*d^2 + mlp 8*d^2) +
+    attention scores 2*2*T*d, plus the vocab projection."""
+    return n_layer * (2 * 12 * d_model ** 2
+                      + 4 * seqlen * d_model) + 2 * vocab * d_model
 
 
 def main_transformer():
@@ -378,40 +579,141 @@ def main_transformer():
                            return_numpy=False)
             return out
 
-        return _timed_loop(step, warmup, steps)
+        dt, done = _timed_loop(step, warmup, steps, errors)
+        return dt / done  # seconds per step
 
-    dt = build_and_time(True)
-    dt_xla = build_and_time(False)
-    tok_s = bsz * seqlen * steps / dt
-    # fwd FLOPs/token: 2*(attn qkvo 4*d^2 + mlp 8*d^2) + attention scores
-    # 2*2*T*d per token; train ~ 3x fwd
-    flops_tok = n_layer * (2 * 12 * d_model ** 2
-                           + 4 * seqlen * d_model) + 2 * vocab * d_model
-    mfu = 3 * tok_s * flops_tok / (PEAK_TFLOPS * 1e12)
-    print(json.dumps({
+    errors = []
+    sps = build_and_time(True)
+    sps_xla = build_and_time(False)
+    tok_s = bsz * seqlen / sps
+    flops_tok = _transformer_flops_per_token(n_layer, d_model, seqlen, vocab)
+    mfu = 3 * tok_s * flops_tok / (PEAK_TFLOPS * 1e12)  # train ~ 3x fwd
+    _emit({
         "metric": "transformer_lm_train_tokens_per_sec",
         "value": round(tok_s, 1),
         "unit": "tokens/sec",
-        "vs_baseline": round(dt_xla / dt, 3),
-        "xla_attention_tokens_per_sec": round(bsz * seqlen * steps / dt_xla,
-                                              1),
+        "vs_baseline": round(sps_xla / sps, 3),
+        "xla_attention_tokens_per_sec": round(bsz * seqlen / sps_xla, 1),
         "batch": bsz, "seqlen": seqlen, "layers": n_layer,
         "d_model": d_model, "amp": AMP, "mfu": round(mfu, 4),
-    }))
+    }, errors)
 
 
-def main():
-    mode = os.environ.get("BENCH_MODE", "resnet")
+def main_ring_attention():
+    """Long-context flagship (VERDICT r4 #7): transformer-LM train step at
+    T=32k with sequence_parallel=True — ring attention over an 'sp' mesh
+    spanning every visible device (1 on the tunneled chip: the ring
+    degenerates to the flash kernels + shard_map, which is exactly the
+    single-chip long-context path; 8 on a CPU host mesh). The einsum
+    path cannot run here at all: its [T, T] residuals are ~4 GB/head.
+    vs_baseline guards the r4 regression number, 1.58 s/step."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+    from jax.sharding import Mesh
+
+    bsz = int(BATCH) if BATCH else 1
+    seqlen = int(os.environ.get("BENCH_SEQLEN", "32768"))
+    n_layer = int(os.environ.get("BENCH_LAYERS", "4"))
+    d_model = int(os.environ.get("BENCH_DMODEL", "512"))
+    n_head = d_model // 64
+    vocab = 8192
+    baseline_s = 1.58            # r4 single-chip T=32k step (round4-state)
+    # steps are ~1.5s each: a lighter default than the global 20/25
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "8"))
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs).reshape(len(devs)), ("sp",))
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        tok = fluid.layers.data(name="tok", shape=[-1, seqlen],
+                                dtype="int64", append_batch_size=False)
+        lab = fluid.layers.data(name="lab", shape=[-1, seqlen],
+                                dtype="int64", append_batch_size=False)
+        loss = models.transformer_lm(
+            tok, lab, vocab_size=vocab, d_model=d_model, n_head=n_head,
+            n_layer=n_layer, use_flash=True, sequence_parallel=True)
+        opt = fluid.optimizer.Adam(learning_rate=1e-4)
+        if AMP:
+            opt = fluid.amp.decorate(opt, level=AMP_LEVEL)
+        opt.minimize(loss, startup_program=startup)
+    main_prog._mesh = mesh
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (bsz, seqlen)).astype(np.int32)
+    labs = rng.integers(0, vocab, (bsz, seqlen)).astype(np.int32)
+    feed = {"tok": jax.device_put(ids, exe.device),
+            "lab": jax.device_put(labs, exe.device)}
+
+    def step():
+        out, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                       return_numpy=False)
+        return out
+
+    errors = []
+    dt, done = _timed_loop(step, warmup, steps, errors)
+    s_step = dt / done
+    tok_s = bsz * seqlen / s_step
+    flops_tok = _transformer_flops_per_token(n_layer, d_model, seqlen, vocab)
+    mfu = 3 * tok_s * flops_tok / (PEAK_TFLOPS * 1e12)
+    _emit({
+        "metric": f"ring_attention_transformer_T{seqlen}_sec_per_step",
+        "value": round(s_step, 3),
+        "unit": "sec/step",
+        "vs_baseline": round(baseline_s / s_step, 3),
+        "tokens_per_sec": round(tok_s, 1),
+        "batch": bsz, "seqlen": seqlen, "layers": n_layer,
+        "d_model": d_model, "sp_devices": len(devs), "amp": AMP,
+        "steps_timed": done, "mfu": round(mfu, 4),
+    }, errors)
+
+
+def _dispatch(mode):
     if mode == "lstm":
         return main_lstm()
     if mode == "attention":
         return main_attention()
     if mode == "transformer":
         return main_transformer()
+    if mode == "ring_attention":
+        return main_ring_attention()
     family, _, job = mode.partition("_")
     if family not in CNN or job not in ("", "infer"):
         raise SystemExit(f"unknown BENCH_MODE={mode}")
     return main_cnn(family, train=(job != "infer"))
+
+
+def main():
+    """Run the selected family; NEVER exit without printing the JSON line.
+
+    A transient failure gets one whole-family rebuild (fresh Program,
+    fresh Executor, fresh jit — the only state a wedged tunnel can hold);
+    a persistent one emits value=null plus the error log so the driver's
+    `parsed` is non-null and carries the diagnosis (VERDICT r4 weak #1)."""
+    mode = os.environ.get("BENCH_MODE", "resnet")
+    for attempt in range(2):
+        log = []
+        try:
+            return _dispatch(mode)
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001 - reported, never swallowed
+            if isinstance(e, BenchError):
+                log.extend(e.errors)
+            log.append(f"attempt{attempt}: {type(e).__name__}: {e}"[:300])
+            if attempt == 0 and _is_transient(e):
+                # carry the failed attempt's log into whatever the rebuilt
+                # family emits: a run that needed a rebuild must say so
+                _CARRIED_ERRORS.extend(log)
+                time.sleep(5.0)
+                continue
+            _emit({"metric": mode, "value": None, "unit": None,
+                   "vs_baseline": None}, log)
+            return 1
 
 
 if __name__ == "__main__":

@@ -17,7 +17,7 @@ from ..param_attr import ParamAttr
 
 def transformer_lm(tokens, labels, vocab_size, d_model=512, n_head=8,
                    n_layer=4, ffn_mult=4, dropout_prob=0.0, is_test=False,
-                   use_flash=False, sequence_parallel=False):
+                   use_flash="auto", sequence_parallel=False):
     """tokens/labels [B, T] int -> mean next-token cross-entropy loss.
 
     Pre-LN residual blocks: x += Wo·attn(LN(x)); x += W2·gelu(W1·LN(x)).

@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["attention_reference", "ring_attention", "ring_attention_sharded"]
+__all__ = ["attention_reference", "ring_attention", "ring_attention_sharded",
+           "ring_attention_bwd_sharded", "flash_ring_eligible"]
 
 
 def _scaled_masked_logits(q, k, causal, scale):
@@ -218,83 +219,123 @@ def _ring_bwd_local(q, k, v, do, o, lse, axis_name, causal, scale):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _shard_map_fn():
+    try:
+        from jax import shard_map
+    except ImportError:              # older jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def _sm(mesh, flash, **smkw):
+    # check_vma off on the flash path: the pallas HLO interpreter's
+    # dynamic_slice hits a varying-manifest false positive when inputs
+    # alias (jax suggests exactly this workaround in its error).
+    # Probe the signature — functools.partial would defer an unknown-
+    # kwarg TypeError to the call site, past any try/except here.
+    shard_map = _shard_map_fn()
+    kw = {}
+    if flash:
+        import inspect
+        try:
+            if "check_vma" in inspect.signature(shard_map).parameters:
+                kw["check_vma"] = False
+        except (TypeError, ValueError):
+            pass
+    return functools.partial(shard_map, mesh=mesh, **kw, **smkw)
+
+
+def flash_ring_eligible(q, mesh, axis: str = "sp") -> bool:
+    """Static check: can the flash (Pallas) ring run for this global shape
+    on this mesh? The per-shard sequence length must divide evenly and tile
+    (mirrors ops.pallas_attention.block_supports on the shard shape). Both
+    the forward op and its explicit grad op consult this, so the backward
+    never has to re-run the forward to find out which path it took."""
+    n_sp = mesh.shape[axis]
+    if q.shape[1] % n_sp != 0:
+        return False
+    from ..ops.pallas_attention import block_supports
+    probe = jax.ShapeDtypeStruct(
+        (q.shape[0], q.shape[1] // n_sp) + tuple(q.shape[2:]), q.dtype)
+    return block_supports(probe, probe)
+
+
 def ring_attention_sharded(q, k, v, mesh, axis: str = "sp",
-                           causal: bool = False, use_flash: bool = False):
+                           causal: bool = False, use_flash: bool = False,
+                           return_lse: bool = False):
     """Convenience wrapper: global q/k/v [B, T, H, D] -> shard_map the ring
     over mesh axis `axis` (T must divide by the axis size). use_flash=True
     runs flash end-to-end: the per-shard blocks on the Pallas kernels in
     BOTH directions (forward online-softmax blocks; backward dQ/dK/dV
     blocks recomputed from the saved logsumexp), the ring across shards.
     Shard shapes that don't tile fall back to the einsum ring, whose
-    backward differentiates through the scan."""
+    backward differentiates through the scan.
+
+    return_lse=True additionally returns the global per-row logsumexp
+    [B, H, T] (f32) — the residual `ring_attention_bwd_sharded` consumes,
+    letting an explicit grad op skip re-running the forward (Pallas custom
+    calls are not CSE'd, so a vjp re-trace would pay the flash forward
+    twice per step)."""
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:              # older jax
-        from jax.experimental.shard_map import shard_map
 
     spec = P(None, axis, None, None)
     lse_spec = P(None, None, axis)
 
-    def _sm(flash, **smkw):
-        # check_vma off on the flash path: the pallas HLO interpreter's
-        # dynamic_slice hits a varying-manifest false positive when inputs
-        # alias (jax suggests exactly this workaround in its error).
-        # Probe the signature — functools.partial would defer an unknown-
-        # kwarg TypeError to the call site, past any try/except here.
-        kw = {}
-        if flash:
-            import inspect
-            try:
-                if "check_vma" in inspect.signature(shard_map).parameters:
-                    kw["check_vma"] = False
-            except (TypeError, ValueError):
-                pass
-        return functools.partial(shard_map, mesh=mesh, **kw, **smkw)
+    def _make(flash, lse):
+        out_specs = (spec, lse_spec) if lse else spec
 
-    def _make(flash):
-        @_sm(flash, in_specs=(spec, spec, spec), out_specs=spec)
+        @_sm(mesh, flash, in_specs=(spec, spec, spec), out_specs=out_specs)
         def run(ql, kl, vl):
             return ring_attention(ql, kl, vl, axis_name=axis,
-                                  causal=causal, use_flash=flash)
+                                  causal=causal, use_flash=flash,
+                                  return_lse=lse)
         return run
 
-    # flash eligibility is static: the per-shard sequence length must tile
-    # (mirror ops.pallas_attention.block_supports on the shard shape)
-    n_sp = mesh.shape[axis]
-    flash_ok = use_flash and q.shape[1] % n_sp == 0
-    if flash_ok:
-        from ..ops.pallas_attention import block_supports
-        probe = jax.ShapeDtypeStruct(
-            (q.shape[0], q.shape[1] // n_sp) + tuple(q.shape[2:]), q.dtype)
-        flash_ok = block_supports(probe, probe)
+    flash_ok = use_flash and flash_ring_eligible(q, mesh, axis)
     if not flash_ok:
-        return _make(False)(q, k, v)
+        return _make(False, return_lse)(q, k, v)
+
+    if return_lse:
+        # caller owns the backward (ring_attention_bwd_sharded)
+        return _make(True, True)(q, k, v)
 
     scale = 1.0 / float(q.shape[-1]) ** 0.5
 
-    @_sm(True, in_specs=(spec, spec, spec), out_specs=(spec, lse_spec))
-    def _fwd_local(ql, kl, vl):
-        return ring_attention(ql, kl, vl, axis_name=axis, causal=causal,
-                              use_flash=True, return_lse=True)
-
-    @_sm(True, in_specs=(spec, spec, spec, spec, spec, lse_spec),
-         out_specs=(spec, spec, spec))
-    def _bwd_local(ql, kl, vl, dol, ol, lsel):
-        return _ring_bwd_local(ql, kl, vl, dol, ol, lsel, axis_name=axis,
-                               causal=causal, scale=scale)
-
     @jax.custom_vjp
     def flash_ring(q, k, v):
-        return _make(True)(q, k, v)
+        return _make(True, False)(q, k, v)
 
     def fwd(q, k, v):
-        o, lse = _fwd_local(q, k, v)
+        o, lse = _make(True, True)(q, k, v)
         return o, (q, k, v, o, lse)
 
     def bwd(res, g):
         qr, kr, vr, o, lse = res
-        return _bwd_local(qr, kr, vr, g, o, lse)
+        return ring_attention_bwd_sharded(qr, kr, vr, g, o, lse, mesh,
+                                          axis=axis, causal=causal,
+                                          scale=scale)
 
     flash_ring.defvjp(fwd, bwd)
     return flash_ring(q, k, v)
+
+
+def ring_attention_bwd_sharded(q, k, v, do, o, lse, mesh, axis: str = "sp",
+                               causal: bool = False, scale=None):
+    """Direct flash-ring backward from the saved (O, LSE) residuals: dQ/dK/
+    dV via the Pallas backward kernels on the same ring schedule — no
+    forward re-execution (the saved LSE is exactly what the blockwise
+    backward needs). Requires `flash_ring_eligible`."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis, None, None)
+    lse_spec = P(None, None, axis)
+    if scale is None:
+        scale = 1.0 / float(q.shape[-1]) ** 0.5
+
+    @_sm(mesh, True, in_specs=(spec, spec, spec, spec, spec, lse_spec),
+         out_specs=(spec, spec, spec))
+    def _bwd(ql, kl, vl, dol, ol, lsel):
+        return _ring_bwd_local(ql, kl, vl, dol, ol, lsel, axis_name=axis,
+                               causal=causal, scale=scale)
+
+    return _bwd(q, k, v, do, o, lse)

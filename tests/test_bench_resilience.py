@@ -1,0 +1,162 @@
+"""bench.py must survive transient infra failures (VERDICT r4 weak #1: a
+single `remote_compile: response body closed` cost round 4 its official
+number). These tests drive the retry/partial-result machinery directly with
+injected failures — no TPU needed."""
+
+import importlib.util
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+
+def _load_bench():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bench():
+    return _load_bench()
+
+
+@pytest.fixture(autouse=True)
+def _no_sleep(bench, monkeypatch):
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+
+class _FlakyStep:
+    """Raises a transient-looking error on selected calls, else returns a
+    finite on-device-like scalar."""
+
+    def __init__(self, fail_on=(), exc=None):
+        self.calls = 0
+        self.fail_on = set(fail_on)
+        self.exc = exc or RuntimeError(
+            "INTERNAL: remote_compile: response body closed")
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls in self.fail_on:
+            raise self.exc
+        return np.ones(())
+
+
+def test_transient_classification(bench):
+    assert bench._is_transient(RuntimeError(
+        "INTERNAL: remote_compile: response body closed"))
+    assert bench._is_transient(OSError("Connection reset by peer"))
+
+    class JaxRuntimeError(Exception):
+        pass
+
+    assert bench._is_transient(JaxRuntimeError("something opaque"))
+    assert not bench._is_transient(AssertionError("non-finite fetch nan"))
+    assert not bench._is_transient(TypeError("bad arg"))
+
+
+def test_once_raising_step_still_yields_number(bench, monkeypatch):
+    """The VERDICT r4 acceptance case: a step that raises once (the r4
+    failure mode) must not kill the measurement."""
+    monkeypatch.setattr(bench, "RETRIES", 2)
+    step = _FlakyStep(fail_on={1})          # dies on the first warmup call
+    dt, done = bench._timed_loop(step, warmup=2, steps=4)
+    assert done == 4 and dt > 0
+
+    step = _FlakyStep(fail_on={4})          # dies mid-timed-loop
+    errors = []
+    dt, done = bench._timed_loop(step, warmup=1, steps=4, errors=errors)
+    assert done == 4 and dt > 0
+    assert any("timed" in e for e in errors)
+
+
+def test_partial_chunks_survive_persistent_failure(bench, monkeypatch):
+    """A late persistent failure keeps the completed chunks: the round
+    still gets a number from the steps that ran."""
+    monkeypatch.setattr(bench, "RETRIES", 1)
+    # chunk size = steps//2 = 2: chunk 1 (calls 2-3) succeeds, chunk 2
+    # always dies -> partial result, not an exception
+    step = _FlakyStep(fail_on={4, 5, 6, 7, 8, 9, 10})
+    dt, done = bench._timed_loop(step, warmup=1, steps=4)
+    assert done == 2 and dt > 0
+
+
+def test_persistent_warmup_failure_raises_bench_error(bench, monkeypatch):
+    monkeypatch.setattr(bench, "RETRIES", 1)
+    step = _FlakyStep(fail_on=set(range(1, 20)))
+    with pytest.raises(bench.BenchError) as ei:
+        bench._timed_loop(step, warmup=1, steps=2)
+    assert any("warmup" in e for e in ei.value.errors)
+
+
+def test_non_transient_fails_fast(bench, monkeypatch):
+    monkeypatch.setattr(bench, "RETRIES", 3)
+    step = _FlakyStep(fail_on={1}, exc=AssertionError("non-finite"))
+    with pytest.raises(AssertionError):
+        bench._timed_loop(step, warmup=1, steps=2)
+    assert step.calls == 1  # no retry burned on a real bug
+
+
+def test_non_transient_after_completed_chunk_still_raises(bench,
+                                                          monkeypatch):
+    """A NaN divergence late in the run must NOT become a partial
+    'success' — only transient infra errors may yield partial numbers."""
+    monkeypatch.setattr(bench, "RETRIES", 2)
+    # warmup=1 (call 1), chunk1 calls 2-3 complete, then the NaN guard
+    step = _FlakyStep(fail_on={4}, exc=AssertionError("non-finite fetch"))
+    with pytest.raises(AssertionError):
+        bench._timed_loop(step, warmup=1, steps=4)
+
+
+def _capture_main(bench, monkeypatch, dispatch):
+    monkeypatch.setattr(bench, "_dispatch", dispatch)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setenv("BENCH_ROOFLINE", "0")
+    bench._ROOFLINE = None
+    bench._CARRIED_ERRORS[:] = []
+    buf = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", buf)
+    rc = bench.main()
+    sys.stdout = sys.__stdout__
+    return rc, buf.getvalue()
+
+
+def test_main_emits_json_on_persistent_failure(bench, monkeypatch):
+    """parsed must never be null for a transient cause: even when every
+    attempt dies, ONE parseable JSON line with the error log comes out."""
+    def dispatch(mode):
+        raise RuntimeError("INTERNAL: remote_compile: response body closed")
+
+    rc, out = _capture_main(bench, monkeypatch, dispatch)
+    assert rc == 1
+    payload = json.loads(out.strip().splitlines()[-1])
+    assert payload["value"] is None
+    assert payload["errors"]
+    assert "remote_compile" in " ".join(payload["errors"])
+
+
+def test_main_rebuilds_family_once_on_transient(bench, monkeypatch):
+    """First whole-family attempt dies transiently -> one rebuild attempt
+    runs the family to completion."""
+    calls = []
+
+    def dispatch(mode):
+        calls.append(mode)
+        if len(calls) == 1:
+            raise RuntimeError("UNAVAILABLE: tunnel reset")
+        bench._emit({"metric": "fake", "value": 1.0,
+                     "unit": "x", "vs_baseline": 1.0})
+
+    rc, out = _capture_main(bench, monkeypatch, dispatch)
+    assert rc is None and len(calls) == 2
+    payload = json.loads(out.strip().splitlines()[-1])
+    assert payload["value"] == 1.0
+    # the rebuilt run must still disclose that attempt 0 died
+    assert any("attempt0" in e for e in payload["errors"])
