@@ -1,7 +1,12 @@
 """Datasets (reference: python/paddle/v2/dataset/ — 14 loaders with
-download+cache). Zero-egress build: each module serves a deterministic
-synthetic surrogate with the real schema unless real files are present
-under common.DATA_HOME (see common.py)."""
+download+cache). Zero-egress build: every module parses the reference's
+real on-disk format when the file is present under common.DATA_HOME
+(mnist idx, cifar pickle tarballs, aclImdb tar, PTB tgz, ml-1m zip,
+conll05st tar+dicts, nltk movie_reviews dir, wmt14/wmt16 tarballs,
+102flowers tgz+mat, VOC tar, uci housing.data, mq2007 txt) and otherwise
+serves a deterministic synthetic surrogate with the same schema — all 14
+real parsers are exercised against format-faithful fixtures in
+tests/test_dataset_real_formats.py."""
 
 from . import (cifar, common, conll05, flowers, imdb, imikolov, mnist,
                movielens, mq2007, sentiment, uci_housing, voc2012, wmt14,
