@@ -58,8 +58,10 @@ def _read_to_dict(dict_size):
 
 
 def _real_reader(file_name, dict_size):
+    # parse the dicts once per reader construction, not once per epoch
+    src_dict, trg_dict = _read_to_dict(dict_size)
+
     def reader():
-        src_dict, trg_dict = _read_to_dict(dict_size)
         with tarfile.open(common.cache_path("wmt14", _FILE)) as f:
             names = [m.name for m in f if m.name.endswith(file_name)]
             for name in names:

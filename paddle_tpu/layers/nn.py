@@ -20,7 +20,8 @@ __all__ = [
     "auc", "mean", "mul", "elementwise_add", "elementwise_sub",
     "elementwise_mul", "elementwise_div", "elementwise_max", "elementwise_min",
     "elementwise_pow", "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
-    "reduce_prod", "matmul", "transpose", "reshape", "split", "topk",
+    "reduce_prod", "matmul", "transpose", "reverse", "reshape", "split",
+    "topk",
     "one_hot", "lrn", "l2_normalize", "clip", "clip_by_norm", "scale",
     "cast", "dropout", "autoincreased_step_counter", "smooth_l1", "log_loss",
     "label_smooth", "cos_sim", "expand", "squeeze", "unsqueeze", "gather",
@@ -526,6 +527,12 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
 
 def transpose(x, perm, name=None):
     return _simple("transpose", x, attrs={"axis": list(perm)}, name=name)
+
+
+def reverse(x, axis, name=None):
+    """Flip x along `axis` (int or list of ints)."""
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    return _simple("reverse", x, attrs={"axis": axis}, name=name)
 
 
 def reshape(x, shape, actual_shape=None, act=None, inplace=True, name=None):

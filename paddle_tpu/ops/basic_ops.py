@@ -250,6 +250,21 @@ def _transpose(ctx, op_, ins):
     return {"Out": [jnp.transpose(jnp.asarray(ins["X"][0]), op_.attr("axis"))]}
 
 
+def _same_shape_infer(op_, block):
+    iv = in_var(op_, block, "X")
+    if iv is not None and iv.shape is not None:
+        set_out(op_, block, "Out", list(iv.shape), iv.dtype)
+
+
+@op("reverse", infer_shape=_same_shape_infer)
+def _reverse(ctx, op_, ins):
+    """Flip along the given axes (serves the v2 rotate layer, the gserver
+    RotateLayer capability — reference gserver/layers/RotateLayer.cpp;
+    linear, so the generic vjp gives the exact gradient)."""
+    return {"Out": [jnp.flip(jnp.asarray(ins["X"][0]),
+                             tuple(op_.attr("axis")))]}
+
+
 def _concat_infer(op_, block):
     axis = op_.attr("axis", 0)
     shapes = []

@@ -9,7 +9,7 @@ reference's book examples moved from v2 to fluid without retraining users.
 """
 
 from . import (activation, data_type, evaluator, event, image, layer,
-               networks, optimizer, parameters, pooling)
+               networks, optimizer, parameters, plot, pooling)
 from .inference import infer
 from .trainer import SGD
 
@@ -40,5 +40,5 @@ def init(**kwargs):
 
 
 __all__ = ["activation", "data_type", "evaluator", "event", "image",
-           "layer", "networks", "optimizer", "parameters", "pooling",
-           "infer", "SGD", "dataset", "reader", "batch", "init"]
+           "layer", "networks", "optimizer", "parameters", "plot",
+           "pooling", "infer", "SGD", "dataset", "reader", "batch", "init"]

@@ -4,13 +4,17 @@ program; the returned Variables ARE the v2 "Layer" handles (the reference
 wrapped config-proto nodes; here the IR is the config).
 
 Coverage follows the layers a reference v2 script actually touches: data /
-fc / embedding / conv / pool / batch_norm / recurrent (lstmemory, grumemory
-and the simple_* fronts in networks.py) / sequence pooling + slicing /
-elementwise combinators (addto, concat, dotmul, mixed-free) / costs +
-similarity heads. Unknown-kwarg policy (ADVICE r3): parameter-affecting
-kwargs (param_attr/bias_attr/name) are FORWARDED, layout-only ones the TPU
-build doesn't need are accepted and ignored by name, anything else raises
-so silent config drift cannot happen."""
+fc / embedding / conv / pool / batch_norm / recurrent (true vanilla
+recurrence, lstmemory, grumemory and the simple_* fronts in networks.py) /
+sequence pooling + slicing / projections + mixed (full_matrix, table,
+identity, dotmul, scaling, trans, conv) / matrix-elementwise layers
+(rotate, norms, distances, outer/linear/bilinear products) / misc
+(maxid, clip, pad, resize, prelu, gated_unit, scale_shift, FM) / costs +
+similarity heads. Unknown-kwarg policy (ADVICE r3/r4): parameter-affecting
+kwargs (param_attr/bias_attr/name, initial_std/initial_mean as
+initializers) are FORWARDED, per-parameter optimizer kwargs warn, layout-
+only ones the TPU build doesn't need are accepted and ignored by name,
+anything else raises so silent config drift cannot happen."""
 
 from __future__ import annotations
 
@@ -23,16 +27,50 @@ from .pooling import pool_name
 # no TPU meaning; accepted (and discarded) by every wrapper for source
 # compatibility with reference configs
 _IGNORED_KW = {"layer_attr", "device", "drop_rate", "error_clipping_threshold",
-               "is_static", "initial_std", "initial_mean", "learning_rate",
-               "momentum", "sparse_update"}
+               "is_static"}
+# kwargs that DO affect the reference model (per-parameter LR/momentum,
+# sparse update path): accepted but warned about, never silently dropped
+# (ADVICE r4)
+_WARN_KW = {"learning_rate", "momentum", "sparse_update"}
+# kwargs mapped onto the fluid initializer (ADVICE r4: these set parameter
+# init in the reference, not layout)
+_INIT_KW = {"initial_std", "initial_mean"}
 
 
 def _split_kw(kw, where):
-    ignored = {k: kw.pop(k) for k in list(kw) if k in _IGNORED_KW}
+    import warnings
+    ignored = {k: kw.pop(k) for k in list(kw)
+               if k in _IGNORED_KW or k in _INIT_KW}
+    for k in list(kw):
+        if k in _WARN_KW:
+            warnings.warn(
+                f"{where}: kwarg '{k}' (per-parameter optimizer setting) "
+                "is not applied on this build — set it on the optimizer "
+                "instead", stacklevel=3)
+            kw.pop(k)
     if kw:
         raise TypeError(f"{where}: unsupported kwargs {sorted(kw)} "
                         "(would silently change the model)")
     return ignored
+
+
+def _attr_with_init(param_attr, ignored):
+    """Fold initial_std/initial_mean (reference: parameter init config)
+    into the fluid ParamAttr as a NormalInitializer, unless the attr
+    already carries an initializer (ADVICE r4)."""
+    if not (_INIT_KW & set(ignored)):
+        return _as_attr(param_attr)
+    from ..initializer import NormalInitializer
+    init = NormalInitializer(loc=float(ignored.get("initial_mean", 0.0)),
+                             scale=float(ignored.get("initial_std", 1.0)))
+    attr = _as_attr(param_attr)
+    if attr is None:
+        return ParamAttr(initializer=init)
+    if getattr(attr, "initializer", None) is None:
+        import copy
+        attr = copy.copy(attr)       # never mutate a (possibly shared) attr
+        attr.initializer = init
+    return attr
 
 
 def _act_name(act):
@@ -69,10 +107,10 @@ def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None,
     """Fully connected (reference fc_layer). param_attr/bias_attr/name are
     forwarded — v2 code names parameters for sharing and decode-time reuse
     (ADVICE r3: silently dropping them broke that)."""
-    _split_kw(kw, "fc")
+    ignored = _split_kw(kw, "fc")
     return _register_named(name, fluid_layers.fc(
         input=input, size=size, act=_act_name(act),
-        param_attr=_as_attr(param_attr),
+        param_attr=_attr_with_init(param_attr, ignored),
         bias_attr=_as_attr(bias_attr), name=name,
         num_flatten_dims=num_flatten_dims))
 
@@ -83,12 +121,13 @@ def embedding(input, size, param_attr=None, **kw):
     vocab = kw.pop("vocab_size", None)
     if vocab is None:
         vocab = kw.pop("input_range", None)
-    _split_kw(kw, "embedding")
+    ignored = _split_kw(kw, "embedding")
     if vocab is None:
         raise ValueError("embedding needs vocab_size= (the reference reads "
                          "it from the data layer's integer_value range)")
     return fluid_layers.embedding(input=input, size=[vocab, size],
-                                  param_attr=_as_attr(param_attr))
+                                  param_attr=_attr_with_init(param_attr,
+                                                             ignored))
 
 
 def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
@@ -161,15 +200,44 @@ def simple_lstm(input, size, **kw):
     return h
 
 
-def recurrent(input, act=None, reverse=False, **kw):
-    """Simple (vanilla) recurrent layer (reference recurrent_layer) built
-    as a 1-gate GRU-free recurrence: fluid has no plain-RNN fused op, so
-    use dynamic_gru on a tripled projection — same sequence contract."""
+def recurrent(input, act=None, reverse=False, bias_attr=None,
+              param_attr=None, **kw):
+    """Simple (vanilla) recurrent layer (reference recurrent_layer,
+    trainer_config_helpers/layers.py:3988): h_t = act(x_t + W·h_{t-1} + b)
+    with the reference's Tanh default — the input is already the
+    projection, so the only parameters are W [size, size] and the bias,
+    matching the reference's parameter count (ADVICE r4: the previous
+    GRU-based stand-in silently changed architecture). Built on the same
+    DynamicRNN machinery as recurrent_group. reverse=True keeps the
+    (documented) GRU fallback — DynamicRNN scans forward only — and warns.
+    """
     _split_kw(kw, "recurrent")
     size = input.shape[-1]
-    proj = fluid_layers.fc(input=input, size=size * 3, num_flatten_dims=2)
-    return fluid_layers.dynamic_gru(input=proj, size=size,
-                                    is_reverse=reverse)
+    # None = reference default (tanh); an explicit Linear/identity act
+    # maps to name None and must stay identity, not become tanh
+    act = "tanh" if act is None else _act_name(act)
+    if reverse:
+        import warnings
+        warnings.warn(
+            "recurrent(reverse=True) runs a reverse dynamic_gru stand-in "
+            "(different parameterization than the reference's simple "
+            "recurrence); feed a reversed sequence for exact semantics",
+            stacklevel=2)
+        proj = fluid_layers.fc(input=input, size=size * 3,
+                               num_flatten_dims=2)
+        return fluid_layers.dynamic_gru(input=proj, size=size,
+                                        is_reverse=True)
+    rnn = fluid_layers.DynamicRNN()
+    with rnn.block():
+        x_t = rnn.step_input(input)
+        prev = rnn.memory(shape=[size])
+        wh = fluid_layers.fc(input=prev, size=size,
+                             param_attr=_as_attr(param_attr),
+                             bias_attr=_as_attr(bias_attr))
+        h = fluid_layers.elementwise_add(x_t, wh, act=act)
+        rnn.update_memory(prev, h)
+        rnn.output(h)
+    return rnn()
 
 
 # --- recurrent group ---------------------------------------------------------
@@ -537,6 +605,296 @@ def sampling_id(input, **kw):
 
 # --- costs -------------------------------------------------------------------
 
+# --- projections + mixed -----------------------------------------------------
+# The reference's mixed_layer sums "projections" (trainer_config_helpers/
+# layers.py mixed_layer + *_projection). Here each projection applies
+# immediately and returns a Variable; mixed() sums them (+ bias, + act) —
+# the functional equivalent of the reference's `with mixed_layer() as m:
+# m += proj` accumulation form.
+
+def full_matrix_projection(input, size, param_attr=None, **kw):
+    """W·x, no bias (reference full_matrix_projection)."""
+    ignored = _split_kw(kw, "full_matrix_projection")
+    return fluid_layers.fc(input=input, size=size,
+                           param_attr=_attr_with_init(param_attr, ignored),
+                           bias_attr=False)
+
+
+def trans_full_matrix_projection(input, size, param_attr=None, **kw):
+    """W^T·x — the weight is created as [size, in] and used transposed so
+    it can be SHARED with a forward projection (reference
+    trans_full_matrix_projection)."""
+    ignored = _split_kw(kw, "trans_full_matrix_projection")
+    attr = _attr_with_init(param_attr, ignored)
+    in_dim = input.shape[-1]
+    w = fluid_layers.create_parameter(shape=[size, in_dim],
+                                      dtype=input.dtype,
+                                      attr=attr)
+    return fluid_layers.matmul(input, w, transpose_y=True)
+
+
+def table_projection(input, size, param_attr=None, **kw):
+    """Embedding-table lookup of integer ids (reference table_projection).
+    Needs vocab_size= like embedding()."""
+    vocab = kw.pop("vocab_size", None)
+    ignored = _split_kw(kw, "table_projection")
+    if vocab is None:
+        raise ValueError("table_projection needs vocab_size=")
+    return fluid_layers.embedding(input=input, size=[vocab, size],
+                                  param_attr=_attr_with_init(param_attr,
+                                                             ignored))
+
+
+def identity_projection(input, offset=None, size=None, **kw):
+    """Pass-through, or a column slice [offset, offset+size) (reference
+    identity_projection)."""
+    _split_kw(kw, "identity_projection")
+    if offset is None:
+        return input
+    if size is None:
+        size = input.shape[-1] - offset
+    total = input.shape[-1]
+    sections = [s for s in (offset, size, total - offset - size) if s > 0]
+    if len(sections) == 1:
+        return input
+    outs = fluid_layers.split(input, sections, dim=-1)
+    return outs[1 if offset > 0 else 0]
+
+
+def dotmul_projection(input, param_attr=None, **kw):
+    """x ∘ w with a learned per-feature weight row (reference
+    dotmul_projection)."""
+    ignored = _split_kw(kw, "dotmul_projection")
+    w = fluid_layers.create_parameter(
+        shape=[input.shape[-1]], dtype=input.dtype,
+        attr=_attr_with_init(param_attr, ignored))
+    return fluid_layers.elementwise_mul(input, w)
+
+
+def scaling_projection(input, param_attr=None, **kw):
+    """w·x with ONE learned scalar (reference scaling_projection)."""
+    ignored = _split_kw(kw, "scaling_projection")
+    w = fluid_layers.create_parameter(
+        shape=[1], dtype=input.dtype,
+        attr=_attr_with_init(param_attr, ignored))
+    return fluid_layers.elementwise_mul(input, w)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, param_attr=None, **kw):
+    """Convolution as a projection: no bias, no activation (reference
+    conv_projection; bias/act come from the enclosing mixed())."""
+    _split_kw(kw, "conv_projection")
+    return fluid_layers.conv2d(input=input, num_filters=num_filters,
+                               filter_size=filter_size, stride=stride,
+                               padding=padding, act=None,
+                               param_attr=_as_attr(param_attr),
+                               bias_attr=False)
+
+
+def mixed(size=None, input=None, act=None, bias_attr=None, name=None, **kw):
+    """Sum of projections + bias + activation (reference mixed_layer).
+    Functional form only: pass the applied projections as `input`
+    (each *_projection here returns a Variable already)."""
+    _split_kw(kw, "mixed")
+    if not input:
+        raise ValueError("mixed() needs input=[projection(...), ...]")
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = fluid_layers.elementwise_add(out, x)
+    if bias_attr:
+        from ..initializer import ConstantInitializer
+        b = fluid_layers.create_parameter(
+            shape=[out.shape[-1]], dtype=out.dtype,
+            attr=_as_attr(bias_attr) if bias_attr is not True else None,
+            default_initializer=ConstantInitializer(0.0))
+        out = fluid_layers.elementwise_add(out, b)
+    act = _act_name(act)
+    if act is not None:
+        out = getattr(fluid_layers, act)(out)
+    return _register_named(name, out)
+
+
+# --- matrix / elementwise layers ---------------------------------------------
+
+def rotate(input, height, width, **kw):
+    """Rotate each flattened [C, height, width] row 90° counter-clockwise
+    (reference rotate_layer / gserver RotateLayer.cpp): out[c, W-1-w, h] =
+    in[c, h, w], emitted as flattened [C*W*H]."""
+    _split_kw(kw, "rotate")
+    total = input.shape[-1]
+    c = total // (height * width)
+    x = fluid_layers.reshape(input, [-1, c, height, width])
+    x = fluid_layers.transpose(x, [0, 1, 3, 2])        # [N, C, W, H]
+    x = fluid_layers.reverse(x, axis=2)                # flip the W axis
+    return fluid_layers.reshape(x, [-1, total])
+
+
+def sum_to_one_norm(input, **kw):
+    """Row-normalize so each row sums to 1 (reference
+    sum_to_one_norm_layer)."""
+    _split_kw(kw, "sum_to_one_norm")
+    s = fluid_layers.reduce_sum(input, dim=-1, keep_dim=True)
+    return fluid_layers.elementwise_div(input, s)
+
+
+def row_l2_norm(input, **kw):
+    """Row-normalize to unit L2 norm (reference row_l2_norm_layer)."""
+    _split_kw(kw, "row_l2_norm")
+    sq = fluid_layers.reduce_sum(
+        fluid_layers.elementwise_mul(input, input), dim=-1, keep_dim=True)
+    return fluid_layers.elementwise_div(input, fluid_layers.sqrt(sq))
+
+
+def l2_distance(a, b, **kw):
+    """Row-wise euclidean distance [N, 1] (reference l2_distance_layer)."""
+    _split_kw(kw, "l2_distance")
+    d = fluid_layers.elementwise_sub(a, b)
+    sq = fluid_layers.reduce_sum(fluid_layers.elementwise_mul(d, d),
+                                 dim=-1, keep_dim=True)
+    return fluid_layers.sqrt(sq)
+
+
+def dot_prod(a, b, **kw):
+    """Row-wise dot product [N, 1] (reference dot_prod_layer)."""
+    _split_kw(kw, "dot_prod")
+    return fluid_layers.reduce_sum(fluid_layers.elementwise_mul(a, b),
+                                   dim=-1, keep_dim=True)
+
+
+def out_prod(a, b, **kw):
+    """Row-wise outer product flattened to [N, da*db] (reference
+    out_prod_layer)."""
+    _split_kw(kw, "out_prod")
+    da, db = a.shape[-1], b.shape[-1]
+    prod = fluid_layers.matmul(fluid_layers.reshape(a, [-1, da, 1]),
+                               fluid_layers.reshape(b, [-1, 1, db]))
+    return fluid_layers.reshape(prod, [-1, da * db])
+
+
+def linear_comb(weights, vectors, size, **kw):
+    """out = sum_m w[:, m] * v[:, m, :], vectors given flattened
+    [N, M*size] (reference linear_comb_layer / convex_comb_layer)."""
+    _split_kw(kw, "linear_comb")
+    m = vectors.shape[-1] // size
+    v = fluid_layers.reshape(vectors, [-1, m, size])
+    w = fluid_layers.reshape(weights, [-1, m, 1])
+    return fluid_layers.reduce_sum(fluid_layers.elementwise_mul(v, w),
+                                   dim=1)
+
+
+convex_comb = linear_comb
+
+
+def tensor(a, b, size, act=None, param_attr=None, bias_attr=None, **kw):
+    """Bilinear tensor product: out_k = a^T W_k b for k < size (reference
+    tensor_layer). W is stored [da, size*db]."""
+    ignored = _split_kw(kw, "tensor")
+    da, db = a.shape[-1], b.shape[-1]
+    w = fluid_layers.create_parameter(
+        shape=[da, size * db], dtype=a.dtype,
+        attr=_attr_with_init(param_attr, ignored))
+    aw = fluid_layers.reshape(fluid_layers.matmul(a, w), [-1, size, db])
+    out = fluid_layers.reduce_sum(
+        fluid_layers.elementwise_mul(
+            aw, fluid_layers.reshape(b, [-1, 1, db])), dim=2)
+    if bias_attr:
+        from ..initializer import ConstantInitializer
+        bias = fluid_layers.create_parameter(
+            shape=[size], dtype=a.dtype,
+            attr=_as_attr(bias_attr) if bias_attr is not True else None,
+            default_initializer=ConstantInitializer(0.0))
+        out = fluid_layers.elementwise_add(out, bias)
+    act = _act_name(act)
+    if act is not None:
+        out = getattr(fluid_layers, act)(out)
+    return out
+
+
+# --- misc layers -------------------------------------------------------------
+
+def maxid(input, **kw):
+    """Row argmax as int64 [N, 1] (reference maxid_layer)."""
+    _split_kw(kw, "maxid")
+    return fluid_layers.reshape(fluid_layers.argmax(input, axis=-1),
+                                [-1, 1])
+
+
+def clip(input, min, max, **kw):  # noqa: A002 - reference argument names
+    """Elementwise clip (reference clip_layer)."""
+    _split_kw(kw, "clip")
+    return fluid_layers.clip(input, min=min, max=max)
+
+
+def resize(input, size, **kw):
+    """Reshape rows to [N*?, size] (reference resize_layer)."""
+    _split_kw(kw, "resize")
+    return fluid_layers.reshape(input, [-1, size])
+
+
+def pad(input, pad_c=None, pad_h=None, pad_w=None, **kw):
+    """Zero-pad a [N, C, H, W] image on channel/height/width (reference
+    pad_layer)."""
+    _split_kw(kw, "pad")
+    pc = pad_c or [0, 0]
+    ph = pad_h or [0, 0]
+    pw = pad_w or [0, 0]
+    return fluid_layers.pad(input, [0, 0] + list(pc) + list(ph) + list(pw))
+
+
+def scale_shift(input, param_attr=None, bias_attr=None, **kw):
+    """w·x + b with ONE learned scale and shift (reference
+    scale_shift_layer)."""
+    ignored = _split_kw(kw, "scale_shift")
+    w = fluid_layers.create_parameter(
+        shape=[1], dtype=input.dtype,
+        attr=_attr_with_init(param_attr, ignored))
+    out = fluid_layers.elementwise_mul(input, w)
+    from ..initializer import ConstantInitializer
+    b = fluid_layers.create_parameter(
+        shape=[1], dtype=input.dtype,
+        attr=_as_attr(bias_attr),
+        default_initializer=ConstantInitializer(0.0))
+    return fluid_layers.elementwise_add(out, b)
+
+
+def prelu(input, param_attr=None, **kw):
+    """Parametric ReLU (reference prelu_layer)."""
+    _split_kw(kw, "prelu")
+    return fluid_layers.prelu(input, mode="all",
+                              param_attr=_as_attr(param_attr))
+
+
+def gated_unit(input, size, act=None, gate_param_attr=None,
+               inproj_param_attr=None, **kw):
+    """act(fc(x)) ∘ sigmoid(fc_gate(x)) (reference gated_unit_layer)."""
+    _split_kw(kw, "gated_unit")
+    u = fluid_layers.fc(input=input, size=size, act=_act_name(act),
+                        param_attr=_as_attr(inproj_param_attr))
+    g = fluid_layers.fc(input=input, size=size, act="sigmoid",
+                        param_attr=_as_attr(gate_param_attr))
+    return fluid_layers.elementwise_mul(u, g)
+
+
+def factorization_machine(input, factor_size, param_attr=None, **kw):
+    """Second-order FM interactions [N, 1]:
+    0.5 * sum_f ((x·V)_f^2 - (x^2·V^2)_f) (reference
+    factorization_machine)."""
+    ignored = _split_kw(kw, "factorization_machine")
+    v = fluid_layers.create_parameter(
+        shape=[input.shape[-1], factor_size], dtype=input.dtype,
+        attr=_attr_with_init(param_attr, ignored))
+    xv = fluid_layers.matmul(input, v)                        # [N, F]
+    x2v2 = fluid_layers.matmul(
+        fluid_layers.elementwise_mul(input, input),
+        fluid_layers.elementwise_mul(v, v))                   # [N, F]
+    diff = fluid_layers.elementwise_sub(
+        fluid_layers.elementwise_mul(xv, xv), x2v2)
+    return fluid_layers.scale(
+        fluid_layers.reduce_sum(diff, dim=-1, keep_dim=True), scale=0.5)
+
+
 def square_error_cost(input, label):
     return fluid_layers.mean(
         fluid_layers.square_error_cost(input=input, label=label))
@@ -577,3 +935,52 @@ def huber_regression_cost(input, label, delta=1.0, **kw):
         x=fluid_layers.scale(input, scale=1.0 / delta),
         y=fluid_layers.scale(label, scale=1.0 / delta), sigma=1.0)
     return fluid_layers.scale(fluid_layers.mean(unit), scale=delta * delta)
+
+
+def sum_cost(input, **kw):
+    """Sum of every element of the input (reference sum_cost)."""
+    _split_kw(kw, "sum_cost")
+    return fluid_layers.reduce_sum(input)
+
+
+def smooth_l1_cost(input, label, **kw):
+    """Mean smooth-L1 between prediction and target rows (reference
+    smooth_l1_cost)."""
+    _split_kw(kw, "smooth_l1_cost")
+    return fluid_layers.mean(fluid_layers.smooth_l1(x=input, y=label))
+
+
+def multi_binary_label_cross_entropy(input, label, **kw):
+    """Multi-label binary cross entropy on PROBABILITIES (the reference
+    layer sits after a sigmoid activation): mean over rows of
+    -sum_k [y log p + (1-y) log(1-p)] (reference
+    multi_binary_label_cross_entropy)."""
+    _split_kw(kw, "multi_binary_label_cross_entropy")
+    eps = 1e-7
+    p = fluid_layers.clip(input, min=eps, max=1.0 - eps)
+    one_m_p = fluid_layers.scale(
+        fluid_layers.scale(p, scale=-1.0), bias=1.0)
+    one_m_y = fluid_layers.scale(
+        fluid_layers.scale(label, scale=-1.0), bias=1.0)
+    ce = fluid_layers.elementwise_add(
+        fluid_layers.elementwise_mul(label, fluid_layers.log(p)),
+        fluid_layers.elementwise_mul(one_m_y, fluid_layers.log(one_m_p)))
+    return fluid_layers.scale(
+        fluid_layers.mean(fluid_layers.reduce_sum(ce, dim=-1)), scale=-1.0)
+
+
+def huber_classification_cost(input, label, **kw):
+    """Smoothed hinge (reference huber_classification_cost): with
+    y' = 2y-1 and z = y'·f, loss = 0 for z >= 1, (1-z)^2 for |z| < 1,
+    -4z for z <= -1. Written as clip(1-z, 0, 2)^2 + 4·relu(-1-z), which
+    matches all three regions continuously."""
+    _split_kw(kw, "huber_classification_cost")
+    y_signed = fluid_layers.scale(label, scale=2.0, bias=-1.0)
+    z = fluid_layers.elementwise_mul(input, y_signed)
+    one_m_z = fluid_layers.scale(z, scale=-1.0, bias=1.0)
+    quad = fluid_layers.clip(one_m_z, min=0.0, max=2.0)
+    lin = fluid_layers.relu(fluid_layers.scale(z, scale=-1.0, bias=-1.0))
+    loss = fluid_layers.elementwise_add(
+        fluid_layers.elementwise_mul(quad, quad),
+        fluid_layers.scale(lin, scale=4.0))
+    return fluid_layers.mean(loss)

@@ -13,7 +13,7 @@ from .pooling import pool_name
 
 __all__ = ["simple_img_conv_pool", "img_conv_group", "vgg_16_network",
            "sequence_conv_pool", "simple_lstm", "bidirectional_lstm",
-           "simple_gru"]
+           "simple_gru", "bidirectional_gru", "simple_attention"]
 
 
 def _act_name(act):
@@ -91,3 +91,48 @@ def simple_gru(input, size, act=None, **kw):
     (reference networks.py simple_gru)."""
     proj = fluid_layers.fc(input=input, size=size * 3, num_flatten_dims=2)
     return fluid_layers.dynamic_gru(input=proj, size=size)
+
+
+def bidirectional_gru(input, size, return_unmerged=False, **kw):
+    """Forward + backward GRU over the sequence, concatenated on the
+    feature axis (reference networks.py bidirectional_gru)."""
+    from .layer import _split_kw
+    _split_kw(kw, "bidirectional_gru")
+    fw_proj = fluid_layers.fc(input=input, size=size * 3,
+                              num_flatten_dims=2)
+    fw = fluid_layers.dynamic_gru(input=fw_proj, size=size)
+    bw_proj = fluid_layers.fc(input=input, size=size * 3,
+                              num_flatten_dims=2)
+    bw = fluid_layers.dynamic_gru(input=bw_proj, size=size,
+                                  is_reverse=True)
+    if return_unmerged:
+        return fw, bw
+    return fluid_layers.concat([fw, bw], axis=-1)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     **kw):
+    """Additive (Bahdanau) attention context vector (reference
+    networks.py:1400 simple_attention): score_j = v·tanh(W·s + U·h_j)
+    with U·h_j precomputed as encoded_proj; softmax over the sequence;
+    context = sum_j a_j h_j. decoder_state is per-batch-row [N, H]; the
+    encoded inputs are sequences."""
+    from .layer import _as_attr as _attr
+    from .layer import _split_kw
+    _split_kw(kw, "simple_attention")
+
+    proj_size = encoded_proj.shape[-1]
+    transform = fluid_layers.fc(input=decoder_state, size=proj_size,
+                                bias_attr=False,
+                                param_attr=_attr(transform_param_attr))
+    expanded = fluid_layers.sequence_expand(x=transform,
+                                            y=encoded_sequence)
+    combined = fluid_layers.tanh(
+        fluid_layers.elementwise_add(expanded, encoded_proj))
+    score = fluid_layers.fc(input=combined, size=1, bias_attr=False,
+                            num_flatten_dims=2,
+                            param_attr=_attr(softmax_param_attr))
+    weights = fluid_layers.sequence_softmax(score)       # [B, T, 1]
+    scaled = fluid_layers.elementwise_mul(encoded_sequence, weights)
+    return fluid_layers.sequence_pool(scaled, "sum")

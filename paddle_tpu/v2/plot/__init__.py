@@ -1,0 +1,5 @@
+"""Training-curve plotting (reference: python/paddle/v2/plot/__init__.py)."""
+
+from .plot import Ploter, PlotData
+
+__all__ = ["Ploter", "PlotData"]

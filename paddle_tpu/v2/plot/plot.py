@@ -1,0 +1,94 @@
+"""Ploter: live train/test curve plotting (reference:
+python/paddle/v2/plot/plot.py). Collects (step, value) series per title;
+plot(path) renders a matplotlib figure to the file when matplotlib is
+available; pathless plot() prints text sparklines (the headless Agg
+backend cannot open a window). DISABLE_PLOT=True turns plot() into a
+no-op like the reference; the data side keeps working either way."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["Ploter", "PlotData"]
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width=60):
+    vals = values[-width:]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(_SPARK[int((v - lo) / span * (len(_SPARK) - 1))]
+                   for v in vals)
+
+
+class Ploter:
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {title: PlotData() for title in args}
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT")
+        self.plt = None
+        if not self.__plot_is_disabled__():
+            try:
+                import matplotlib
+                matplotlib.use("Agg")          # headless-safe backend
+                import matplotlib.pyplot as plt
+                self.plt = plt
+            except Exception:                  # text fallback below
+                self.plt = None
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__ == "True"
+
+    def append(self, title, step, value):
+        assert isinstance(title, str)
+        assert title in self.__plot_data__
+        self.__plot_data__[title].append(step, float(value))
+
+    def plot(self, path=None):
+        if self.__plot_is_disabled__():
+            return
+        titles = [t for t in self.__args__
+                  if self.__plot_data__[t].step]
+        if self.plt is not None and path is not None:
+            for title in titles:
+                data = self.__plot_data__[title]
+                self.plt.plot(data.step, data.value)
+            self.plt.legend(titles, loc="upper left")
+            self.plt.savefig(path)
+            self.plt.gcf().clear()
+            return
+        # pathless (terminal) display, or no matplotlib: text sparklines —
+        # the Agg backend can't show a window, so the data must reach the
+        # user some other way
+        lines = []
+        for title in titles:
+            data = self.__plot_data__[title]
+            lines.append(f"{title}: {_sparkline(data.value)} "
+                         f"(last {data.value[-1]:.6g} "
+                         f"@ step {data.step[-1]})")
+        text = "\n".join(lines)
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text + "\n")
+        else:
+            print(text)
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()
