@@ -56,11 +56,16 @@ static int stage_input(paddle_tpu_machine machine, char* spec, int slot) {
   int ndim = 0;
   int64_t numel = 1;
   char* dsave = NULL;
-  for (char* d = strtok_r(dims_s, "x", &dsave); d && ndim < 4;
-       d = strtok_r(NULL, "x", &dsave)) {
+  char* d = strtok_r(dims_s, "x", &dsave);
+  for (; d && ndim < 4; d = strtok_r(NULL, "x", &dsave)) {
     dims[ndim] = atoll(d);
     numel *= dims[ndim];
     ndim++;
+  }
+  if (d != NULL) { /* more than 4 dims: fail loudly, never truncate */
+    fprintf(stderr, "input %s: more than 4 dims in spec (got extra '%s')\n",
+            name, d);
+    return 1;
   }
 
   paddle_tpu_dtype dt;
@@ -104,9 +109,15 @@ static int stage_input(paddle_tpu_machine machine, char* spec, int slot) {
     int64_t offs[64];
     int n = 0;
     char* lsave = NULL;
-    for (char* o = strtok_r(lod_s, ",", &lsave); o && n < 64;
-         o = strtok_r(NULL, ",", &lsave))
+    char* o = strtok_r(lod_s, ",", &lsave);
+    for (; o && n < 64; o = strtok_r(NULL, ",", &lsave))
       offs[n++] = atoll(o);
+    if (o != NULL) { /* >64 offsets: fail loudly, never truncate */
+      fprintf(stderr,
+              "input %s: more than 64 lod offsets in spec (extra '%s')\n",
+              name, o);
+      return 1;
+    }
     CHECK(paddle_tpu_machine_set_input_lod(machine, name, offs, n));
   }
   return 0;

@@ -413,6 +413,29 @@ def padded_to_pack(padded: np.ndarray, lengths: np.ndarray,
             [outer_offs.tolist(), inner_offs.tolist()])
 
 
+def _hlo_supplier(fn, feed_vals, state_vals, rng_counter):
+    """Zero-arg lazy supplier of the block's optimized HLO text for the
+    profiler's per-op device table. Captures ONLY avals (shapes/dtypes),
+    never the arrays — state buffers are donated and must not be kept
+    alive. fn.lower(avals).compile() re-resolves through jax's compilation
+    cache, so a warm supply costs milliseconds, not a recompile."""
+    def _aval(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None or dtype is None:
+            arr = np.asarray(x)
+            shape, dtype = arr.shape, arr.dtype
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    avals = jax.tree_util.tree_map(_aval,
+                                   (feed_vals, state_vals, rng_counter))
+
+    def supply():
+        return fn.lower(*avals).compile().as_text()
+
+    return supply
+
+
 class _CompiledBlock:
     def __init__(self, fn, state_names, feed_names, fetch_names, program):
         self.fn = fn
@@ -525,6 +548,14 @@ class Executor:
                 if use_program_cache:
                     self._cache[key] = compiled
             from . import profiler as profiler_mod
+            if profiler_mod.wants_device_table() and \
+                    not profiler_mod.has_hlo_supplier(id(compiled.fn)):
+                # once per compiled block: building the aval pytree every
+                # step would inflate the host timings being measured
+                profiler_mod.register_hlo_supplier(
+                    id(compiled.fn),
+                    _hlo_supplier(compiled.fn, feed_vals, state_vals,
+                                  np.uint32(rng_counter)))
             with jax.default_device(self.device):
                 with profiler_mod.record("executor_run(jit)"):
                     fetch_vals, fetch_lens, new_state = compiled.fn(
@@ -704,7 +735,12 @@ class Executor:
                    for slot, vals in ins.items()}
         t0 = time.perf_counter() if _BENCHMARK and _EAGER else None
         try:
-            outs = opdef.lower(ctx, op, ins)
+            # the scope lands in every emitted HLO instruction's
+            # metadata op_name ("jit(fn)/.../pd.<type>/<prim>") — the hook
+            # the profiler's per-op device table joins timings against
+            # (profiler._print_device_table / xplane.hlo_op_names)
+            with jax.named_scope(f"pd.{op.type}"):
+                outs = opdef.lower(ctx, op, ins)
         except (AssertionError, TypeError, ValueError, IndexError) as e:
             # PADDLE_ENFORCE-style context (reference platform/enforce.h +
             # utils/CustomStackTrace.h layer-stack dump): name the failing

@@ -160,10 +160,16 @@ def save_checkpoint(executor, dirname: str, step: int, main_program=None,
     every-process-writes behavior for process-local dirnames."""
     import jax
     os.makedirs(dirname, exist_ok=True)
+    rstate = None
     if reader is not None:
+        # snapshot the reader position ONCE and reuse it for both the
+        # per-process file and the leader's meta below — a prefetch thread
+        # advancing the reader between two state() calls would otherwise
+        # record two different stream positions for the same step
+        # (ADVICE r4)
+        rstate = reader.state(in_flight=reader_in_flight)
         # per-process reader position: distinct filename per process, so
         # non-leaders persist their shard's stream position too
-        rstate = reader.state(in_flight=reader_in_flight)
         fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".rdr.tmp")
         with os.fdopen(fd, "w") as f:
             json.dump({"step": step, **rstate}, f)
@@ -176,8 +182,8 @@ def save_checkpoint(executor, dirname: str, step: int, main_program=None,
     os.makedirs(ckpt_dir, exist_ok=True)
     io_mod.save_persistables(executor, ckpt_dir, main_program=main_program)
     meta = {"step": step, **(extra_meta or {})}
-    if reader is not None:
-        meta.update(reader.state(in_flight=reader_in_flight))
+    if rstate is not None:
+        meta.update(rstate)
     fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".meta.tmp")
     with os.fdopen(fd, "w") as f:
         json.dump(meta, f)

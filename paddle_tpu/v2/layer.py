@@ -37,10 +37,19 @@ _WARN_KW = {"learning_rate", "momentum", "sparse_update"}
 _INIT_KW = {"initial_std", "initial_mean"}
 
 
-def _split_kw(kw, where):
+def _split_kw(kw, where, init_ok=False):
+    """init_ok=True marks wrappers that fold initial_std/initial_mean into
+    their param attr via _attr_with_init; everywhere else those kwargs
+    warn — they affect the reference model and must never vanish
+    silently (ADVICE/review r5)."""
     import warnings
     ignored = {k: kw.pop(k) for k in list(kw)
                if k in _IGNORED_KW or k in _INIT_KW}
+    if not init_ok and (_INIT_KW & set(ignored)):
+        warnings.warn(
+            f"{where}: initial_std/initial_mean are not applied by this "
+            "wrapper — pass a param_attr with an initializer instead",
+            stacklevel=3)
     for k in list(kw):
         if k in _WARN_KW:
             warnings.warn(
@@ -107,7 +116,7 @@ def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None,
     """Fully connected (reference fc_layer). param_attr/bias_attr/name are
     forwarded — v2 code names parameters for sharing and decode-time reuse
     (ADVICE r3: silently dropping them broke that)."""
-    ignored = _split_kw(kw, "fc")
+    ignored = _split_kw(kw, "fc", init_ok=True)
     return _register_named(name, fluid_layers.fc(
         input=input, size=size, act=_act_name(act),
         param_attr=_attr_with_init(param_attr, ignored),
@@ -121,7 +130,7 @@ def embedding(input, size, param_attr=None, **kw):
     vocab = kw.pop("vocab_size", None)
     if vocab is None:
         vocab = kw.pop("input_range", None)
-    ignored = _split_kw(kw, "embedding")
+    ignored = _split_kw(kw, "embedding", init_ok=True)
     if vocab is None:
         raise ValueError("embedding needs vocab_size= (the reference reads "
                          "it from the data layer's integer_value range)")
@@ -133,11 +142,12 @@ def embedding(input, size, param_attr=None, **kw):
 def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
              padding=0, act=None, param_attr=None, bias_attr=None, **kw):
     """Image convolution (reference img_conv_layer)."""
-    _split_kw(kw, "img_conv")
+    ignored = _split_kw(kw, "img_conv", init_ok=True)
     return fluid_layers.conv2d(input=input, num_filters=num_filters,
                                filter_size=filter_size, stride=stride,
                                padding=padding, act=_act_name(act),
-                               param_attr=_as_attr(param_attr),
+                               param_attr=_attr_with_init(param_attr,
+                                                          ignored),
                                bias_attr=_as_attr(bias_attr))
 
 
@@ -211,7 +221,7 @@ def recurrent(input, act=None, reverse=False, bias_attr=None,
     DynamicRNN machinery as recurrent_group. reverse=True keeps the
     (documented) GRU fallback — DynamicRNN scans forward only — and warns.
     """
-    _split_kw(kw, "recurrent")
+    ignored = _split_kw(kw, "recurrent", init_ok=True)
     size = input.shape[-1]
     # None = reference default (tanh); an explicit Linear/identity act
     # maps to name None and must stay identity, not become tanh
@@ -225,14 +235,17 @@ def recurrent(input, act=None, reverse=False, bias_attr=None,
             stacklevel=2)
         proj = fluid_layers.fc(input=input, size=size * 3,
                                num_flatten_dims=2)
-        return fluid_layers.dynamic_gru(input=proj, size=size,
-                                        is_reverse=True)
+        return fluid_layers.dynamic_gru(
+            input=proj, size=size, is_reverse=True,
+            param_attr=_attr_with_init(param_attr, ignored),
+            bias_attr=_as_attr(bias_attr))
     rnn = fluid_layers.DynamicRNN()
     with rnn.block():
         x_t = rnn.step_input(input)
         prev = rnn.memory(shape=[size])
         wh = fluid_layers.fc(input=prev, size=size,
-                             param_attr=_as_attr(param_attr),
+                             param_attr=_attr_with_init(param_attr,
+                                                        ignored),
                              bias_attr=_as_attr(bias_attr))
         h = fluid_layers.elementwise_add(x_t, wh, act=act)
         rnn.update_memory(prev, h)
@@ -614,7 +627,7 @@ def sampling_id(input, **kw):
 
 def full_matrix_projection(input, size, param_attr=None, **kw):
     """W·x, no bias (reference full_matrix_projection)."""
-    ignored = _split_kw(kw, "full_matrix_projection")
+    ignored = _split_kw(kw, "full_matrix_projection", init_ok=True)
     return fluid_layers.fc(input=input, size=size,
                            param_attr=_attr_with_init(param_attr, ignored),
                            bias_attr=False)
@@ -624,7 +637,7 @@ def trans_full_matrix_projection(input, size, param_attr=None, **kw):
     """W^T·x — the weight is created as [size, in] and used transposed so
     it can be SHARED with a forward projection (reference
     trans_full_matrix_projection)."""
-    ignored = _split_kw(kw, "trans_full_matrix_projection")
+    ignored = _split_kw(kw, "trans_full_matrix_projection", init_ok=True)
     attr = _attr_with_init(param_attr, ignored)
     in_dim = input.shape[-1]
     w = fluid_layers.create_parameter(shape=[size, in_dim],
@@ -637,7 +650,7 @@ def table_projection(input, size, param_attr=None, **kw):
     """Embedding-table lookup of integer ids (reference table_projection).
     Needs vocab_size= like embedding()."""
     vocab = kw.pop("vocab_size", None)
-    ignored = _split_kw(kw, "table_projection")
+    ignored = _split_kw(kw, "table_projection", init_ok=True)
     if vocab is None:
         raise ValueError("table_projection needs vocab_size=")
     return fluid_layers.embedding(input=input, size=[vocab, size],
@@ -664,7 +677,7 @@ def identity_projection(input, offset=None, size=None, **kw):
 def dotmul_projection(input, param_attr=None, **kw):
     """x ∘ w with a learned per-feature weight row (reference
     dotmul_projection)."""
-    ignored = _split_kw(kw, "dotmul_projection")
+    ignored = _split_kw(kw, "dotmul_projection", init_ok=True)
     w = fluid_layers.create_parameter(
         shape=[input.shape[-1]], dtype=input.dtype,
         attr=_attr_with_init(param_attr, ignored))
@@ -673,7 +686,7 @@ def dotmul_projection(input, param_attr=None, **kw):
 
 def scaling_projection(input, param_attr=None, **kw):
     """w·x with ONE learned scalar (reference scaling_projection)."""
-    ignored = _split_kw(kw, "scaling_projection")
+    ignored = _split_kw(kw, "scaling_projection", init_ok=True)
     w = fluid_layers.create_parameter(
         shape=[1], dtype=input.dtype,
         attr=_attr_with_init(param_attr, ignored))
@@ -684,11 +697,12 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
                     stride=1, padding=0, param_attr=None, **kw):
     """Convolution as a projection: no bias, no activation (reference
     conv_projection; bias/act come from the enclosing mixed())."""
-    _split_kw(kw, "conv_projection")
+    ignored = _split_kw(kw, "conv_projection", init_ok=True)
     return fluid_layers.conv2d(input=input, num_filters=num_filters,
                                filter_size=filter_size, stride=stride,
                                padding=padding, act=None,
-                               param_attr=_as_attr(param_attr),
+                               param_attr=_attr_with_init(param_attr,
+                                                          ignored),
                                bias_attr=False)
 
 
@@ -700,6 +714,11 @@ def mixed(size=None, input=None, act=None, bias_attr=None, name=None, **kw):
     if not input:
         raise ValueError("mixed() needs input=[projection(...), ...]")
     inputs = input if isinstance(input, (list, tuple)) else [input]
+    if size is not None and inputs[0].shape[-1] != size:
+        raise ValueError(
+            f"mixed(size={size}) disagrees with its projections' width "
+            f"{inputs[0].shape[-1]} — the reference treats size as the "
+            "output width, so this would silently change the model")
     out = inputs[0]
     for x in inputs[1:]:
         out = fluid_layers.elementwise_add(out, x)
@@ -790,7 +809,7 @@ convex_comb = linear_comb
 def tensor(a, b, size, act=None, param_attr=None, bias_attr=None, **kw):
     """Bilinear tensor product: out_k = a^T W_k b for k < size (reference
     tensor_layer). W is stored [da, size*db]."""
-    ignored = _split_kw(kw, "tensor")
+    ignored = _split_kw(kw, "tensor", init_ok=True)
     da, db = a.shape[-1], b.shape[-1]
     w = fluid_layers.create_parameter(
         shape=[da, size * db], dtype=a.dtype,
@@ -846,7 +865,7 @@ def pad(input, pad_c=None, pad_h=None, pad_w=None, **kw):
 def scale_shift(input, param_attr=None, bias_attr=None, **kw):
     """w·x + b with ONE learned scale and shift (reference
     scale_shift_layer)."""
-    ignored = _split_kw(kw, "scale_shift")
+    ignored = _split_kw(kw, "scale_shift", init_ok=True)
     w = fluid_layers.create_parameter(
         shape=[1], dtype=input.dtype,
         attr=_attr_with_init(param_attr, ignored))
@@ -861,17 +880,19 @@ def scale_shift(input, param_attr=None, bias_attr=None, **kw):
 
 def prelu(input, param_attr=None, **kw):
     """Parametric ReLU (reference prelu_layer)."""
-    _split_kw(kw, "prelu")
+    ignored = _split_kw(kw, "prelu", init_ok=True)
     return fluid_layers.prelu(input, mode="all",
-                              param_attr=_as_attr(param_attr))
+                              param_attr=_attr_with_init(param_attr,
+                                                         ignored))
 
 
 def gated_unit(input, size, act=None, gate_param_attr=None,
                inproj_param_attr=None, **kw):
     """act(fc(x)) ∘ sigmoid(fc_gate(x)) (reference gated_unit_layer)."""
-    _split_kw(kw, "gated_unit")
+    ignored = _split_kw(kw, "gated_unit", init_ok=True)
     u = fluid_layers.fc(input=input, size=size, act=_act_name(act),
-                        param_attr=_as_attr(inproj_param_attr))
+                        param_attr=_attr_with_init(inproj_param_attr,
+                                                   ignored))
     g = fluid_layers.fc(input=input, size=size, act="sigmoid",
                         param_attr=_as_attr(gate_param_attr))
     return fluid_layers.elementwise_mul(u, g)
@@ -881,7 +902,7 @@ def factorization_machine(input, factor_size, param_attr=None, **kw):
     """Second-order FM interactions [N, 1]:
     0.5 * sum_f ((x·V)_f^2 - (x^2·V^2)_f) (reference
     factorization_machine)."""
-    ignored = _split_kw(kw, "factorization_machine")
+    ignored = _split_kw(kw, "factorization_machine", init_ok=True)
     v = fluid_layers.create_parameter(
         shape=[input.shape[-1], factor_size], dtype=input.dtype,
         attr=_attr_with_init(param_attr, ignored))

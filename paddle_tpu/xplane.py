@@ -1,0 +1,176 @@
+"""XSpace/XPlane (.xplane.pb) wire-format parser + HLO->IR-op attribution.
+
+jax.profiler.trace writes xplane protos; the tensorboard profile plugin in
+this image can't load them (TF version skew), so this decodes the wire
+format directly — only the fields needed to aggregate device-op time:
+
+  XSpace.planes=1 / XPlane{name=2, lines=3, event_metadata=4}
+  XLine{events=6} / XEvent{metadata_id=1, duration_ps=3}
+  XEventMetadata map entry {key=1, value=2} / XEventMetadata{id=1, name=2}
+
+The executor wraps every IR op's lowering in jax.named_scope("pd.<type>")
+(executor._exec_op), so the compiled module's per-instruction
+`metadata={op_name="jit(fn)/.../pd.<type>/<prim>"}` carries the IR op that
+emitted each HLO instruction — including the representative op of each
+fusion. `hlo_op_names` extracts that mapping from the optimized HLO text
+and `attribute` joins it with the xplane per-instruction timings, giving
+the reference ParseEvents-style "which op eats the step" table for the
+whole-block jit (reference platform/profiler.h:137-166)."""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Dict, Optional
+
+__all__ = ["aggregate", "aggregate_dir", "hlo_op_names", "attribute",
+           "category", "fields", "parse_plane"]
+
+
+def _varint(buf, i):
+    r = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return r, i
+        shift += 7
+
+
+def fields(buf):
+    """Yield (field_number, wire_type, value) over a serialized message."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i: i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i: i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i: i + 8]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wt}")
+        yield fno, wt, v
+
+
+def parse_plane(buf):
+    name = ""
+    lines = []
+    meta = {}
+    for fno, wt, v in fields(buf):
+        if fno == 2 and wt == 2:
+            name = v.decode("utf-8", "replace")
+        elif fno == 3 and wt == 2:
+            lines.append(v)
+        elif fno == 4 and wt == 2:
+            k = None
+            mname = None
+            for f2, w2, v2 in fields(v):
+                if f2 == 1 and w2 == 0:
+                    k = v2
+                elif f2 == 2 and w2 == 2:
+                    for f3, w3, v3 in fields(v2):
+                        if f3 == 1 and w3 == 0 and k is None:
+                            k = v3
+                        elif f3 == 2 and w3 == 2:
+                            mname = v3.decode("utf-8", "replace")
+            if k is not None and mname is not None:
+                meta[k] = mname
+    return name, lines, meta
+
+
+def aggregate(path) -> Dict[str, Dict[str, int]]:
+    """-> {plane_name: {event_name: total_ps}}"""
+    buf = open(path, "rb").read()
+    out = {}
+    for fno, wt, v in fields(buf):
+        if fno != 1 or wt != 2:
+            continue
+        pname, lines, meta = parse_plane(v)
+        agg = out.setdefault(pname, {})
+        for line in lines:
+            for f2, w2, v2 in fields(line):
+                if f2 != 4 or w2 != 2:   # XLine.events
+                    continue
+                mid = dur = 0
+                for f3, w3, v3 in fields(v2):
+                    if f3 == 1 and w3 == 0:
+                        mid = v3
+                    elif f3 == 3 and w3 == 0:
+                        dur = v3
+                name = meta.get(mid, f"#{mid}")
+                agg[name] = agg.get(name, 0) + dur
+    return out
+
+
+def aggregate_dir(trace_dir) -> Dict[str, int]:
+    """Merge every plane of every .xplane.pb under trace_dir into ONE
+    {event_name: total_ps} map (device planes hold the HLO instruction
+    events; host-side junk events simply never match the HLO mapping)."""
+    merged: Dict[str, int] = {}
+    for p in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                       recursive=True):
+        for agg in aggregate(p).values():
+            for name, ps in agg.items():
+                merged[name] = merged.get(name, 0) + ps
+    return merged
+
+
+_HLO_LINE = re.compile(
+    r"%?([\w.\-]+)\s*=\s*\S.*metadata=\{[^}]*op_name=\"([^\"]*)\"")
+_PD_SCOPE = re.compile(r"pd\.([A-Za-z0-9_@]+)")
+
+
+def hlo_op_names(hlo_text: str) -> Dict[str, str]:
+    """{instruction_name: ir_op_type} from optimized-HLO text, using the
+    pd.<type> named-scope component of each op_name (instructions outside
+    any pd scope — infeed, copies, jax-internal reductions — map to their
+    trailing op_name component)."""
+    out: Dict[str, str] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_LINE.search(line)
+        if not m:
+            continue
+        instr, op_name = m.group(1), m.group(2)
+        pd = _PD_SCOPE.search(op_name)
+        if pd:
+            out[instr] = pd.group(1)
+        else:
+            tail = [t for t in op_name.split("/") if t]
+            out[instr] = tail[-1] if tail else op_name
+    return out
+
+
+def attribute(instr_ps: Dict[str, int],
+              opname_by_instr: Dict[str, str],
+              other_label: Optional[str] = None) -> Dict[str, int]:
+    """Join per-instruction timings with the HLO mapping -> per-IR-op-type
+    total picoseconds. Events with no HLO mapping (host bookkeeping,
+    runtime internals) are dropped, or pooled under `other_label`."""
+    agg: Dict[str, int] = {}
+    for instr, ps in instr_ps.items():
+        op = opname_by_instr.get(instr)
+        if op is None:
+            if other_label is None:
+                continue
+            op = other_label
+        agg[op] = agg.get(op, 0) + ps
+    return agg
+
+
+def category(name: str) -> str:
+    """HLO instruction text -> coarse op kind ('%fusion.123 = ...' ->
+    'fusion'; falls back to the leading token)."""
+    tok = name.lstrip("%").split(" ", 1)[0]
+    return tok.split(".")[0]

@@ -1,0 +1,63 @@
+"""Spawn-context training worker for test_elastic_training: imports ONLY
+stdlib + numpy + master.py loaded by path (never the paddle_tpu package
+__init__, which imports jax — forking/spawning into jax is the documented
+hazard). One worker = one elastic trainer: lease tasks from the shared
+TaskQueue, compute the task's gradient against the pass-start parameters,
+write it to an idempotent per-task file (re-execution after a crash
+overwrites the same file — at-least-once dispatch composes with sync SGD
+without double counting), mark finished."""
+
+import json
+import os
+import time
+
+
+def _load_master_standalone():
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "parallel", "master.py")
+    spec = importlib.util.spec_from_file_location("_master_standalone", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def worker(qdir, wid, data_path, params_path, grads_dir, log_path,
+           slow_s=0.0, marker_path=None):
+    """Drain the current pass: for each leased task, grad of 0.5*||Xw-y||^2
+    over the task's sample ids, saved as grads_dir/task_<tid>.npy."""
+    import numpy as np
+
+    master = _load_master_standalone()
+    q = master.TaskQueue(qdir, timeout_s=2.0)
+    blob = np.load(data_path)
+    x_all, y_all = blob["x"], blob["y"]
+    w = np.load(params_path)
+    consumed = []
+    first = True
+    while True:
+        leased = q.get_task(wid)
+        if leased is None:
+            if q.pass_done():
+                break
+            time.sleep(0.05)
+            continue
+        tid, chunks = leased
+        sample_ids = [s for chunk in chunks for s in chunk]
+        if first and marker_path is not None:
+            with open(marker_path, "w") as f:
+                f.write(wid)
+        first = False
+        if slow_s:
+            time.sleep(slow_s)         # window for the parent's SIGKILL
+        ids = np.asarray(sample_ids)
+        xb, yb = x_all[ids], y_all[ids]
+        grad = xb.T @ (xb @ w - yb)    # sum-reduction: task-additive
+        tmp = os.path.join(grads_dir, f".task_{tid}.tmp.{wid}")
+        np.save(tmp, grad)
+        os.replace(tmp + ".npy", os.path.join(grads_dir,
+                                              f"task_{tid}.npy"))
+        consumed.extend(int(i) for i in sample_ids)
+        q.task_finished(tid)
+    with open(log_path, "w") as f:
+        json.dump(consumed, f)

@@ -26,7 +26,41 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
 
+def inventory():
+    """Scriptable surface counts (VERDICT r4 #9: self-reported inventory
+    must come from dir(), not prose): fluid layer functions, v2 layer
+    wrappers, v2 networks composites, registered ops."""
+    import inspect
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu import layers as fluid_layers
+    from paddle_tpu.ops import registry
+    from paddle_tpu.v2 import layer as v2_layer
+    from paddle_tpu.v2 import networks as v2_networks
+
+    def _public_callables(mod):
+        out = []
+        for n in dir(mod):
+            if n.startswith("_"):
+                continue
+            obj = getattr(mod, n)
+            if callable(obj) and not inspect.ismodule(obj):
+                out.append(n)
+        return sorted(out)
+
+    counts = {
+        "fluid_layer_fns": len(_public_callables(fluid_layers)),
+        "v2_layer_wrappers": len(_public_callables(v2_layer)),
+        "v2_networks_composites": len(_public_callables(v2_networks)),
+        "registered_ops": len(registry.registered_ops()),
+    }
+    import json
+    print(json.dumps(counts))
+    return 0
+
+
 def main(path):
+    if path == "--inventory":
+        return inventory()
     if not os.path.exists(path):
         print(f"no record file at {path} — run the suite with "
               f"PADDLE_TPU_RECORD_OPS={path} first (see module docstring)")

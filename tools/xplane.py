@@ -1,12 +1,6 @@
-"""Minimal XSpace/XPlane (.xplane.pb) parser + per-op time aggregation.
-
-jax.profiler.trace writes xplane protos; the tensorboard profile plugin in
-this image can't load them (TF version skew), so this decodes the wire
-format directly — only the fields needed to aggregate device-op time:
-
-  XSpace.planes=1 / XPlane{name=2, lines=3, event_metadata=4}
-  XLine{events=6} / XEvent{metadata_id=1, duration_ps=3}
-  XEventMetadata map entry {key=1, value=2} / XEventMetadata{id=1, name=2}
+"""CLI over paddle_tpu.xplane: per-op time aggregation of jax.profiler
+xplane traces (the tensorboard profile plugin in this image can't load
+them — TF version skew — so this decodes the wire format directly).
 
 Usage: python tools/xplane.py <trace_dir_or_file> [top_n]
 """
@@ -14,101 +8,20 @@ Usage: python tools/xplane.py <trace_dir_or_file> [top_n]
 from __future__ import annotations
 
 import glob
+import importlib.util
 import os
 import sys
 
-
-def _varint(buf, i):
-    r = 0
-    shift = 0
-    while True:
-        b = buf[i]
-        i += 1
-        r |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return r, i
-        shift += 7
-
-
-def fields(buf):
-    """Yield (field_number, wire_type, value) over a serialized message."""
-    i = 0
-    n = len(buf)
-    while i < n:
-        key, i = _varint(buf, i)
-        fno, wt = key >> 3, key & 7
-        if wt == 0:
-            v, i = _varint(buf, i)
-        elif wt == 2:
-            ln, i = _varint(buf, i)
-            v = buf[i: i + ln]
-            i += ln
-        elif wt == 5:
-            v = buf[i: i + 4]
-            i += 4
-        elif wt == 1:
-            v = buf[i: i + 8]
-            i += 8
-        else:
-            raise ValueError(f"wire type {wt}")
-        yield fno, wt, v
-
-
-def parse_plane(buf):
-    name = ""
-    lines = []
-    meta = {}
-    for fno, wt, v in fields(buf):
-        if fno == 2 and wt == 2:
-            name = v.decode("utf-8", "replace")
-        elif fno == 3 and wt == 2:
-            lines.append(v)
-        elif fno == 4 and wt == 2:
-            k = None
-            mname = None
-            for f2, w2, v2 in fields(v):
-                if f2 == 1 and w2 == 0:
-                    k = v2
-                elif f2 == 2 and w2 == 2:
-                    for f3, w3, v3 in fields(v2):
-                        if f3 == 1 and w3 == 0 and k is None:
-                            k = v3
-                        elif f3 == 2 and w3 == 2:
-                            mname = v3.decode("utf-8", "replace")
-            if k is not None and mname is not None:
-                meta[k] = mname
-    return name, lines, meta
-
-
-def aggregate(path):
-    """-> {plane_name: {op_name: total_ps}}"""
-    buf = open(path, "rb").read()
-    out = {}
-    for fno, wt, v in fields(buf):
-        if fno != 1 or wt != 2:
-            continue
-        pname, lines, meta = parse_plane(v)
-        agg = out.setdefault(pname, {})
-        for line in lines:
-            for f2, w2, v2 in fields(line):
-                if f2 != 4 or w2 != 2:   # XLine.events
-                    continue
-                mid = dur = 0
-                for f3, w3, v3 in fields(v2):
-                    if f3 == 1 and w3 == 0:
-                        mid = v3
-                    elif f3 == 3 and w3 == 0:
-                        dur = v3
-                name = meta.get(mid, f"#{mid}")
-                agg[name] = agg.get(name, 0) + dur
-    return out
-
-
-def category(name: str) -> str:
-    """HLO instruction text -> coarse op kind ('%fusion.123 = ...' ->
-    'fusion'; falls back to the leading token)."""
-    tok = name.lstrip("%").split(" ", 1)[0]
-    return tok.split(".")[0]
+# load paddle_tpu/xplane.py directly by path: it is pure stdlib, and going
+# through the package __init__ would drag in jax/the framework — this CLI
+# must keep working in the stripped TF-skew environments it exists for
+_xp_path = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "xplane.py")
+_spec = importlib.util.spec_from_file_location("_xplane_standalone",
+                                               _xp_path)
+_xplane = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_xplane)
+aggregate, category = _xplane.aggregate, _xplane.category
 
 
 def main():
