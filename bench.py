@@ -53,13 +53,12 @@ WARMUP = int(os.environ.get("BENCH_WARMUP", "25"))
 AMP = os.environ.get("BENCH_AMP", "1") == "1"
 AMP_LEVEL = os.environ.get("BENCH_AMP_LEVEL", "O2")
 # per-chip bf16 peak for MFU reporting (v5e ~197 TF/s, v4 ~275, v5p ~459);
-# override with BENCH_PEAK_TFLOPS for other chips. NOTE (r3 measured): the
-# tunneled chip in this environment sustains ~32 TF/s bf16 on pure in-graph
-# matmul chains (tools/jax_resnet_ref.py probes; high run-to-run variance,
-# 2x bf16-over-f32 confirms full MXU datapath engagement) — the framework's
-# step and a hand-rolled pure-JAX step both saturate that sustained rate,
-# so MFU against the nominal 197 TF/s peak tops out near 0.16 here
-# regardless of program quality.
+# override with BENCH_PEAK_TFLOPS for other chips. The in-session
+# _roofline_cached probe measures what the chip+tunnel actually sustains
+# (r5: ~104-108 TF/s bf16 — the r3 "~32 TF/s ceiling" was a probe
+# artifact) and every mode reports mfu_vs_sustained against it; ResNet's
+# ~30-32 TF/s step equals a hand-rolled pure-JAX step in the same session
+# (tools/jax_resnet_ref.py), locating the rest in XLA's conv codegen.
 PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
 
 # Per-family config. flops = forward GFLOPs/image at 224x224 (mul+add as 2);
@@ -534,12 +533,11 @@ def main_transformer():
     """Transformer-LM training step (models/transformer.py) with flash
     attention: tokens/sec + MFU. No reference counterpart (2018);
     vs_baseline is the ratio against the same model on the XLA einsum
-    attention path (use_flash=False). Measured honestly: the standalone
-    flash kernels beat the einsum (1.5-1.6x fwd+bwd at these shapes); in
-    the whole-program jit the einsum path is still modestly faster at
-    benchmark sizes (~1.2x — the custom call limits cross-op fusion) —
-    flash's end-to-end value is MEMORY (O(T) residuals; T=16k+ trains
-    where the einsum path's [T,T] residuals cannot)."""
+    attention path (use_flash=False). With the r5-tuned 512/1024 tiles
+    flash WINS end-to-end from T=2048 up (measured on v5e: 1.14x at
+    T=2048, 1.32x at 4096, 1.65x at 8192) on top of its O(T) memory;
+    below 2048 the einsum path fuses better and auto-selection keeps it
+    (ops/nn_ops._flash_auto_threshold)."""
     import jax
     import paddle_tpu as fluid
     from paddle_tpu import models

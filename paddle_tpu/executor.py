@@ -417,8 +417,10 @@ def _hlo_supplier(fn, feed_vals, state_vals, rng_counter):
     """Zero-arg lazy supplier of the block's optimized HLO text for the
     profiler's per-op device table. Captures ONLY avals (shapes/dtypes),
     never the arrays — state buffers are donated and must not be kept
-    alive. fn.lower(avals).compile() re-resolves through jax's compilation
-    cache, so a warm supply costs milliseconds, not a recompile."""
+    alive. supply() is an AOT lower().compile(): a REAL recompile unless
+    the persistent compilation cache covers it, which is why the profiler
+    caps its supplier registry and only traced sessions pay this — at
+    stop_profiler, never inside the timed region."""
     def _aval(x):
         shape = getattr(x, "shape", None)
         dtype = getattr(x, "dtype", None)

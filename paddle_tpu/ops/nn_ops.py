@@ -688,11 +688,13 @@ def _sdpa_grad(fwd, no_grad_set):
 def _flash_auto_threshold():
     """Sequence length at which auto-selection flips from the XLA einsum
     path to the Pallas flash kernel. Below it the einsum wins end-to-end
-    (the custom call is a fusion barrier; measured round 4-5, bench.py
-    transformer mode); at/above it flash's O(T) memory and larger tiles
-    win. Env-tunable for other chips."""
+    (the custom call is a fusion barrier); at/above it flash WINS with
+    the r5-tuned 512/1024 tiles — measured on v5e in the transformer
+    bench: 1.13x at T=2048, 1.32x at 4096, 1.65x at 8192 over the einsum
+    path (bench.py BENCH_MODE=transformer). Env-tunable for other
+    chips."""
     import os
-    return int(os.environ.get("PADDLE_TPU_FLASH_AUTO_T", "4096"))
+    return int(os.environ.get("PADDLE_TPU_FLASH_AUTO_T", "2048"))
 
 
 def _ring_uses_flash(op_, q, mesh):

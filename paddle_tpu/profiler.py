@@ -91,12 +91,20 @@ def wants_device_table() -> bool:
     return _active and _start_trace_dir[0] is not None
 
 
+_MAX_HLO_SUPPLIERS = 4  # each supply() is a full AOT recompile at stop
+
+
 def has_hlo_supplier(key: int) -> bool:
-    return key in _hlo_suppliers
+    # saturated registry counts as "has": with program caching off every
+    # step builds a fresh compiled fn, and an unbounded registry would
+    # both pin them all alive and recompile each one at stop_profiler
+    return key in _hlo_suppliers or \
+        len(_hlo_suppliers) >= _MAX_HLO_SUPPLIERS
 
 
 def register_hlo_supplier(key: int, supplier):
-    _hlo_suppliers.setdefault(key, supplier)
+    if len(_hlo_suppliers) < _MAX_HLO_SUPPLIERS:
+        _hlo_suppliers.setdefault(key, supplier)
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
@@ -129,8 +137,12 @@ def _print_device_table(trace_dir, sorted_key=None):
     _hlo_suppliers.clear()
     if not mapping:
         return
-    instr_ps = xplane.aggregate_dir(trace_dir)
-    agg = xplane.attribute(instr_ps, mapping)
+    try:
+        instr_ps = xplane.aggregate_dir(trace_dir)
+        agg = xplane.attribute(instr_ps, mapping)
+    except Exception as e:  # noqa: BLE001 - truncated/foreign .xplane.pb
+        print(f"[device] (trace unreadable: {type(e).__name__}: {e})")
+        return
     if not agg:
         return
     rows = sorted(agg.items(), key=lambda kv: -kv[1])

@@ -714,11 +714,19 @@ def mixed(size=None, input=None, act=None, bias_attr=None, name=None, **kw):
     if not input:
         raise ValueError("mixed() needs input=[projection(...), ...]")
     inputs = input if isinstance(input, (list, tuple)) else [input]
-    if size is not None and inputs[0].shape[-1] != size:
-        raise ValueError(
-            f"mixed(size={size}) disagrees with its projections' width "
-            f"{inputs[0].shape[-1]} — the reference treats size as the "
-            "output width, so this would silently change the model")
+    shape = inputs[0].shape
+    if size is not None and shape is not None and all(
+            d is not None and d > 0 for d in shape[1:]):
+        # reference size = FLATTENED output width (conv projections emit
+        # [N, C, H, W] whose size is C*H*W)
+        width = 1
+        for d in shape[1:]:
+            width *= d
+        if width != size:
+            raise ValueError(
+                f"mixed(size={size}) disagrees with its projections' "
+                f"width {width} — the reference treats size as the "
+                "output width, so this would silently change the model")
     out = inputs[0]
     for x in inputs[1:]:
         out = fluid_layers.elementwise_add(out, x)
