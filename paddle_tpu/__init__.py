@@ -47,6 +47,9 @@ from . import average
 from . import errors
 from . import v2
 from . import flags
+from . import concurrency
+from .concurrency import (make_channel, channel_send, channel_recv,
+                          channel_close, Go, Select)
 from .parallel import transpiler
 from .parallel.transpiler import DistributeTranspiler
 

@@ -1,0 +1,280 @@
+"""Go-style channels + select (reference: paddle/fluid/framework/
+channel.h:25-86 buffered/unbuffered semantics, python/paddle/fluid/
+concurrency.py:27-429 make_channel/channel_send/channel_recv/
+channel_close/Go/Select).
+
+DESIGN (closes the F15 gap the TPU way): the reference's channels are IR
+ops executed by its interpreted C++ executor — concurrency INSIDE the
+graph. Under a whole-block XLA compile there is no interpreter to
+schedule against, and every in-graph use of channels (double buffering,
+reader pipelines, parameter prefetch) is subsumed by compiled dataflow +
+the reader machinery. What survives is the HOST-side role: orchestrating
+Python producers/consumers around the compiled step (exactly where the
+reference demos used them — feeding queues from IO threads). So this
+module implements the same user surface with the same semantics at the
+host level, over threads:
+
+  ch = make_channel(capacity=0)     # 0 = unbuffered rendezvous
+  go(producer, ch)                  # goroutine = daemon thread
+  channel_send(ch, x)               # blocks per Go semantics
+  val, ok = channel_recv(ch)        # ok=False once closed AND drained
+  channel_close(ch)
+  Select().case(...).default(...).run()
+
+Semantics match framework/channel.h: unbuffered sends rendezvous with a
+receiver; buffered sends block only when full; close wakes all blockers,
+pending buffered items still drain, receives on a drained closed channel
+return (None, False), and sending on a closed channel raises
+ChannelClosedError (the reference PADDLE_ENFORCEs)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+__all__ = ["Channel", "ChannelClosedError", "make_channel", "channel_send",
+           "channel_recv", "channel_close", "go", "Go", "Select"]
+
+
+class ChannelClosedError(RuntimeError):
+    """Send attempted on a closed channel (channel.h: enforced error)."""
+
+
+class _Offer:
+    """One unbuffered send in flight: the value plus its handoff flag."""
+
+    __slots__ = ("value", "taken")
+
+    def __init__(self, value):
+        self.value = value
+        self.taken = False
+
+
+class Channel:
+    """Blocking FIFO channel; capacity 0 means rendezvous (channel.h:25:
+    an unbuffered send completes only when a receiver takes the value)."""
+
+    def __init__(self, capacity: int = 0, dtype=None):
+        if capacity < 0:
+            raise ValueError("channel capacity must be >= 0")
+        self.capacity = capacity
+        self.dtype = dtype           # kept for reference API parity
+        self._buf: List[Any] = []
+        self._offers: List[_Offer] = []
+        self._closed = False
+        self._cond = threading.Condition()
+
+    # --- core ops -----------------------------------------------------------
+    def send(self, value, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            if self._closed:
+                raise ChannelClosedError("send on closed channel")
+            if self.capacity > 0:
+                while len(self._buf) >= self.capacity and not self._closed:
+                    if not self._wait(deadline):
+                        raise TimeoutError("channel send timed out")
+                if self._closed:
+                    raise ChannelClosedError("send on closed channel")
+                self._buf.append(value)
+                self._cond.notify_all()
+                return
+            offer = _Offer(value)
+            self._offers.append(offer)
+            self._cond.notify_all()
+            while not offer.taken and not self._closed:
+                if not self._wait(deadline):
+                    if offer.taken:  # taken exactly at the deadline:
+                        return       # the value WAS delivered
+                    if offer in self._offers:
+                        self._offers.remove(offer)
+                    raise TimeoutError("channel send timed out")
+            if not offer.taken:      # closed under us (Go: send panics)
+                if offer in self._offers:
+                    self._offers.remove(offer)
+                raise ChannelClosedError("channel closed during send")
+
+    def recv(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                got = self._try_recv_locked()
+                if got is not None:
+                    return got
+                if self._closed:
+                    return None, False
+                if not self._wait(deadline):
+                    raise TimeoutError("channel recv timed out")
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # --- non-blocking forms (Select) ---------------------------------------
+    def try_send(self, value, wait: float = 0.002) -> bool:
+        """Non-blocking-in-spirit send: buffered channels commit or fail
+        instantly; an unbuffered channel posts the offer, gives any
+        receiver — blocked in recv() OR polling via another Select —
+        `wait` seconds (2 ms, a few Select poll periods) to take it, then
+        withdraws. The brief window is what lets two Selects rendezvous
+        on a capacity-0 channel instead of livelocking."""
+        with self._cond:
+            if self._closed:
+                raise ChannelClosedError("send on closed channel")
+            if self.capacity > 0:
+                if len(self._buf) >= self.capacity:
+                    return False
+                self._buf.append(value)
+                self._cond.notify_all()
+                return True
+            offer = _Offer(value)
+            self._offers.append(offer)
+            self._cond.notify_all()
+            deadline = time.monotonic() + wait
+            while not offer.taken and not self._closed:
+                if not self._wait(deadline):
+                    break
+            if not offer.taken:
+                if offer in self._offers:
+                    self._offers.remove(offer)
+                return False
+            return True
+
+    def try_recv(self) -> Optional[Tuple[Any, bool]]:
+        """(value, True) if a value was available, (None, False) if closed
+        and drained, None if nothing is ready yet."""
+        with self._cond:
+            got = self._try_recv_locked()
+            if got is not None:
+                return got
+            if self._closed:
+                return None, False
+            return None
+
+    # --- helpers ------------------------------------------------------------
+    def _try_recv_locked(self):
+        if self._buf:
+            value = self._buf.pop(0)
+            self._cond.notify_all()
+            return value, True
+        while self._offers:
+            offer = self._offers.pop(0)
+            if not offer.taken:
+                offer.taken = True
+                self._cond.notify_all()
+                return offer.value, True
+        return None
+
+    def _wait(self, deadline) -> bool:
+        if deadline is None:
+            self._cond.wait()
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        self._cond.wait(remaining)
+        return time.monotonic() < deadline or self._buf or self._offers \
+            or self._closed
+
+    def __len__(self):
+        with self._cond:
+            return len(self._buf) + sum(not o.taken for o in self._offers)
+
+
+def make_channel(dtype=None, capacity: int = 0) -> Channel:
+    """(reference concurrency.py:279) dtype is recorded, not enforced —
+    the host-level channel carries arbitrary Python/numpy values."""
+    return Channel(capacity=capacity, dtype=dtype)
+
+
+def channel_send(channel: Channel, value, is_copy: bool = False,
+                 timeout: Optional[float] = None) -> None:
+    """(reference concurrency.py:335) is_copy mirrors the reference
+    signature: True snapshots numpy values so later in-place mutation by
+    the producer can't race the consumer."""
+    if is_copy:
+        import copy as _copy
+        value = _copy.deepcopy(value)
+    channel.send(value, timeout=timeout)
+
+
+def channel_recv(channel: Channel, return_value=None,
+                 timeout: Optional[float] = None) -> Tuple[Any, bool]:
+    """(reference concurrency.py:385) -> (value, ok). `return_value` is
+    accepted for signature parity (the reference used it as the output
+    var holder)."""
+    return channel.recv(timeout=timeout)
+
+
+def channel_close(channel: Channel) -> None:
+    """(reference concurrency.py:426)"""
+    channel.close()
+
+
+def go(fn: Callable, *args, **kwargs) -> threading.Thread:
+    """Launch fn concurrently — the goroutine (reference Go block,
+    concurrency.py:27). The reference's `with Go():` captured an IR
+    sub-block to run on executor threads; Python executes a with-body
+    eagerly, so the honest host-level surface is a function launcher.
+    Returns the (daemon) thread for joining."""
+    t = threading.Thread(target=fn, args=args, kwargs=kwargs, daemon=True)
+    t.start()
+    return t
+
+
+Go = go   # reference-name alias
+
+
+class Select:
+    """Go-style select over channel operations (reference Select,
+    concurrency.py:193): blocks until one registered case can run, picks
+    uniformly among ready cases, runs its callback, returns the case
+    index. .default() makes it non-blocking."""
+
+    _POLL = 0.0005
+
+    def __init__(self):
+        self._cases = []             # (kind, channel, value, callback)
+        self._default = None
+
+    def case(self, action: str, channel: Channel, value=None,
+             callback: Optional[Callable] = None) -> "Select":
+        if action not in ("send", "recv"):
+            raise ValueError("Select.case action must be 'send' or 'recv'")
+        self._cases.append((action, channel, value, callback))
+        return self
+
+    def default(self, callback: Optional[Callable] = None) -> "Select":
+        self._default = callback if callback is not None else (lambda: None)
+        return self
+
+    def run(self, timeout: Optional[float] = None) -> int:
+        """Returns the index of the executed case (-1 for default)."""
+        if not self._cases and self._default is None:
+            raise ValueError("empty select would block forever")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            order = list(range(len(self._cases)))
+            random.shuffle(order)    # Go: uniform choice among ready cases
+            for i in order:
+                action, ch, value, cb = self._cases[i]
+                if action == "recv":
+                    got = ch.try_recv()
+                    if got is not None:
+                        if cb is not None:
+                            cb(*got)
+                        return i
+                else:
+                    if ch.try_send(value):
+                        if cb is not None:
+                            cb()
+                        return i
+            if self._default is not None:
+                self._default()
+                return -1
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError("select timed out")
+            time.sleep(self._POLL)

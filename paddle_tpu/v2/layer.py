@@ -966,6 +966,203 @@ def huber_regression_cost(input, label, delta=1.0, **kw):
     return fluid_layers.scale(fluid_layers.mean(unit), scale=delta * delta)
 
 
+# --- second wrapper tranche (r5): remaining trainer_config_helpers tail --
+
+def multiplex(input, index=None, **kw):
+    """Row-wise select among N same-shaped inputs by per-row index
+    (reference multiplex_layer: input[0] is the index column when index
+    is not given separately, matching the legacy calling convention)."""
+    _split_kw(kw, "multiplex")
+    if index is None:
+        index, *inputs = input
+    else:
+        inputs = list(input)
+    return fluid_layers.multiplex(inputs, index)
+
+
+def row_conv(input, context_len, act=None, param_attr=None, **kw):
+    """Lookahead row convolution over a sequence (reference
+    row_conv_layer; DeepSpeech2's streaming-friendly context).
+    context_len COUNTS the current step (reference contract: the filter
+    is [context_len, D]), so the fluid op's future_context_size is
+    context_len - 1."""
+    ignored = _split_kw(kw, "row_conv", init_ok=True)
+    return fluid_layers.row_conv(input,
+                                 future_context_size=context_len - 1,
+                                 param_attr=_attr_with_init(param_attr,
+                                                            ignored),
+                                 act=_act_name(act))
+
+
+def spp(input, pyramid_height=3, pool_type="max", **kw):
+    """Spatial pyramid pooling over [N, C, H, W] (reference spp_layer)."""
+    _split_kw(kw, "spp")
+    return fluid_layers.spp(input, pyramid_height=pyramid_height,
+                            pool_type=pool_name(pool_type))
+
+
+def block_expand(input, block_x=1, block_y=1, stride_x=1, stride_y=1,
+                 padding_x=0, padding_y=0, **kw):
+    """im2col the [N, C, H, W] feature map into a sequence of flattened
+    blocks (reference block_expand_layer — OCR's conv-to-sequence
+    bridge; fluid grew the same op as im2sequence)."""
+    _split_kw(kw, "block_expand")
+    return fluid_layers.im2sequence(
+        input, filter_size=[block_y, block_x],
+        stride=[stride_y, stride_x],
+        padding=[padding_y, padding_x])
+
+
+def conv_shift(a, b, **kw):
+    """Circular correlation of each row of a with the (odd-width) kernel
+    row b (reference conv_shift_layer; NTM addressing)."""
+    _split_kw(kw, "conv_shift")
+    return fluid_layers.conv_shift(a, b)
+
+
+def seq_slice(input, starts, ends=None, **kw):
+    """Per-sequence slice [starts, ends) (reference seq_slice_layer:
+    `ends` are END POSITIONS; the fluid op takes lengths, so lower as
+    length = ends - starts)."""
+    _split_kw(kw, "seq_slice")
+    length = fluid_layers.elementwise_sub(ends, starts)
+    return fluid_layers.sequence_slice(input, offset=starts,
+                                       length=length)
+
+
+def sub_seq(input, offsets, sizes, **kw):
+    """Sub-sequence extraction (reference sub_seq_layer) — same lowering
+    as seq_slice."""
+    _split_kw(kw, "sub_seq")
+    return fluid_layers.sequence_slice(input, offset=offsets, length=sizes)
+
+
+def kmax_seq_score(input, beam_size=1, **kw):
+    """Top-k score INDICES within each sequence (reference
+    kmax_seq_score_layer: input is a [T, 1] score sequence; emits the k
+    best positions per sequence). Padding steps are pushed to -1e30 via
+    the sequence mask so they can never rank."""
+    _split_kw(kw, "kmax_seq_score")
+    flat = fluid_layers.reshape(input, [0, -1])          # [B, T]
+    mask = fluid_layers.sequence_mask(input)             # [B, T] 1/0
+    neg = fluid_layers.scale(
+        fluid_layers.scale(mask, scale=-1.0, bias=1.0), scale=-1e30)
+    masked = fluid_layers.elementwise_add(
+        fluid_layers.elementwise_mul(flat, mask), neg)
+    _vals, idx = fluid_layers.topk(masked, k=beam_size)
+    return idx
+
+
+def get_output(input, arg_name=None, **kw):
+    """(reference get_output_layer) Layers here return their outputs
+    directly (tuples for multi-output layers), so this is selection on an
+    already-materialized tuple — kept for config compatibility."""
+    _split_kw(kw, "get_output")
+    if isinstance(input, (list, tuple)):
+        if isinstance(arg_name, int):
+            return input[arg_name]
+        return input[0] if arg_name in (None, "out", "output") else input[1]
+    return input
+
+
+def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha=0.1,
+                                **kw):
+    """CE plus alpha * log(Z)^2 where Z is each row's probability mass —
+    pushes the (already-softmaxed) rows toward self-normalization
+    (reference cross_entropy_with_selfnorm)."""
+    _split_kw(kw, "cross_entropy_with_selfnorm")
+    ce = fluid_layers.cross_entropy(input=input, label=label)
+    z = fluid_layers.reduce_sum(input, dim=-1, keep_dim=True)
+    logz = fluid_layers.log(fluid_layers.clip(z, min=1e-12, max=1e12))
+    penalty = fluid_layers.elementwise_mul(logz, logz)
+    return fluid_layers.mean(
+        fluid_layers.elementwise_add(
+            ce, fluid_layers.scale(penalty,
+                                   scale=float(softmax_selfnorm_alpha))))
+
+
+def _two_pow_minus_one(x):
+    """2^x - 1 (the NDCG gain) via exp(x ln 2)."""
+    import math
+    return fluid_layers.scale(
+        fluid_layers.exp(fluid_layers.scale(x, scale=math.log(2.0))),
+        bias=-1.0)
+
+
+def _gt_mask(a, b):
+    """float 1.0 where a > b (strict), via sign((a-b)) clamped to {0,1}:
+    sign is -1/0/+1, so relu(sign) is exactly the strict-greater mask."""
+    return fluid_layers.relu(
+        fluid_layers.sign(fluid_layers.elementwise_sub(a, b)))
+
+
+def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, **kw):
+    """LambdaRank listwise cost (reference lambda_cost_layer): pairwise
+    logistic losses weighted by the |ΔNDCG@k| of swapping each pair in
+    the ranking the predicted scores induce. input = predicted scores
+    [N, L], score = relevance labels [N, L] (dense per-list rows; the
+    reference consumed one LoD sequence per list). max_sort_size is
+    accepted for signature parity — the dense form ranks the whole
+    list."""
+    import math
+
+    import numpy as _np
+
+    _split_kw(kw, "lambda_cost")
+    pred, rel = input, score
+    length = int(pred.shape[-1])
+    k = min(NDCG_num, length)
+
+    # ideal DCG@k per list: top-k relevances against 1/log2(rank+2)
+    rel_sorted, _ = fluid_layers.topk(rel, k=k)
+    discounts = fluid_layers.assign(
+        (1.0 / _np.log2(_np.arange(2, k + 2))).astype(_np.float32))
+    idcg = fluid_layers.reduce_sum(
+        fluid_layers.elementwise_mul(_two_pow_minus_one(rel_sorted),
+                                     discounts), dim=-1, keep_dim=True)
+    idcg = fluid_layers.clip(idcg, min=1e-6, max=1e12)   # all-zero lists
+
+    # predicted 0-based descending rank: rank_i = #{j : s_j > s_i}
+    s_i = fluid_layers.unsqueeze(pred, axes=[2])         # [N, L, 1]
+    s_j = fluid_layers.unsqueeze(pred, axes=[1])         # [N, 1, L]
+    rank = fluid_layers.reduce_sum(_gt_mask(s_j, s_i), dim=-1)  # [N, L]
+
+    # NDCG@k discount at each item's predicted rank (0 past position k)
+    log2rank = fluid_layers.scale(
+        fluid_layers.log(fluid_layers.scale(rank, bias=2.0)),
+        scale=1.0 / math.log(2.0))
+    inside_k = _gt_mask(fluid_layers.scale(rank, scale=0.0,
+                                           bias=float(k)), rank)
+    disc = fluid_layers.elementwise_div(inside_k, log2rank)  # [N, L]
+
+    # |ΔNDCG| of swapping i and j
+    gain = _two_pow_minus_one(rel)                       # [N, L]
+    dg = fluid_layers.elementwise_sub(
+        fluid_layers.unsqueeze(gain, axes=[2]),
+        fluid_layers.unsqueeze(gain, axes=[1]))
+    dd = fluid_layers.elementwise_sub(
+        fluid_layers.unsqueeze(disc, axes=[2]),
+        fluid_layers.unsqueeze(disc, axes=[1]))
+    delta = fluid_layers.elementwise_div(
+        fluid_layers.abs(fluid_layers.elementwise_mul(dg, dd)),
+        fluid_layers.unsqueeze(idcg, axes=[2]))          # [N, L, L]
+
+    # pairwise logistic loss log(1 + e^-(s_i - s_j)) for rel_i > rel_j,
+    # in the overflow-safe softplus form relu(-d) + log(1 + e^-|d|)
+    diff = fluid_layers.elementwise_sub(s_i, s_j)
+    loglo = fluid_layers.elementwise_add(
+        fluid_layers.relu(fluid_layers.scale(diff, scale=-1.0)),
+        fluid_layers.log(fluid_layers.scale(
+            fluid_layers.exp(fluid_layers.scale(fluid_layers.abs(diff),
+                                                scale=-1.0)), bias=1.0)))
+    pair_mask = _gt_mask(fluid_layers.unsqueeze(rel, axes=[2]),
+                         fluid_layers.unsqueeze(rel, axes=[1]))
+    weighted = fluid_layers.elementwise_mul(
+        fluid_layers.elementwise_mul(loglo, delta), pair_mask)
+    return fluid_layers.mean(fluid_layers.reduce_sum(
+        fluid_layers.reduce_sum(weighted, dim=-1), dim=-1))
+
+
 def sum_cost(input, **kw):
     """Sum of every element of the input (reference sum_cost)."""
     _split_kw(kw, "sum_cost")

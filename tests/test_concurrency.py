@@ -1,0 +1,226 @@
+"""Go-style channels + select (reference framework/channel.h:25-86,
+fluid/concurrency.py:27-429 — the F15 capability, redesigned host-side:
+see paddle_tpu/concurrency.py docstring for why in-graph CSP is subsumed
+under whole-block XLA while the host orchestration role survives)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.concurrency import (Channel, ChannelClosedError, Select,
+                                    channel_close, channel_recv,
+                                    channel_send, go, make_channel)
+
+
+class TestChannelSemantics:
+    def test_buffered_fifo_and_close_drain(self):
+        ch = make_channel(capacity=3)
+        for i in range(3):
+            channel_send(ch, i)
+        channel_close(ch)
+        got = [channel_recv(ch) for _ in range(4)]
+        # pending items drain after close, then (None, False)
+        assert got == [(0, True), (1, True), (2, True), (None, False)]
+
+    def test_send_on_closed_raises(self):
+        ch = make_channel(capacity=1)
+        channel_close(ch)
+        with pytest.raises(ChannelClosedError):
+            channel_send(ch, 1)
+
+    def test_buffered_send_blocks_when_full(self):
+        ch = make_channel(capacity=1)
+        channel_send(ch, "a")
+        with pytest.raises(TimeoutError):
+            ch.send("b", timeout=0.05)
+        assert channel_recv(ch) == ("a", True)
+        channel_send(ch, "b")                  # room again
+        assert channel_recv(ch) == ("b", True)
+
+    def test_unbuffered_rendezvous(self):
+        """capacity=0: the send completes only when a receiver takes the
+        value (channel.h:25 unbuffered contract)."""
+        ch = make_channel(capacity=0)
+        order = []
+
+        def sender():
+            channel_send(ch, 42)
+            order.append("send-done")
+
+        t = go(sender)
+        time.sleep(0.05)
+        assert not order                       # blocked: nobody received
+        val, ok = channel_recv(ch)
+        t.join(timeout=5)
+        assert (val, ok) == (42, True)
+        assert order == ["send-done"]
+
+    def test_unbuffered_send_raises_if_closed_while_blocked(self):
+        ch = make_channel(capacity=0)
+        errs = []
+
+        def sender():
+            try:
+                channel_send(ch, 1)
+            except ChannelClosedError:
+                errs.append("closed")
+
+        t = go(sender)
+        time.sleep(0.05)
+        channel_close(ch)
+        t.join(timeout=5)
+        assert errs == ["closed"]
+
+    def test_recv_blocks_until_send(self):
+        ch = make_channel(capacity=0)
+        out = []
+
+        def receiver():
+            out.append(channel_recv(ch))
+
+        t = go(receiver)
+        time.sleep(0.05)
+        assert not out
+        channel_send(ch, "x")
+        t.join(timeout=5)
+        assert out == [("x", True)]
+
+    def test_is_copy_snapshots_value(self):
+        ch = make_channel(capacity=1)
+        arr = np.zeros(3)
+        channel_send(ch, arr, is_copy=True)
+        arr += 99                              # producer mutates after send
+        got, ok = channel_recv(ch)
+        assert ok and np.all(got == 0)
+
+
+class TestGoAndPipelines:
+    def test_producer_consumer_pipeline(self):
+        """The reference demos' channel idiom: a producer goroutine feeds
+        a bounded channel, the consumer drains until close."""
+        ch = make_channel(capacity=4)
+
+        def producer():
+            for i in range(20):
+                channel_send(ch, i * i)
+            channel_close(ch)
+
+        go(producer)
+        got = []
+        while True:
+            val, ok = channel_recv(ch)
+            if not ok:
+                break
+            got.append(val)
+        assert got == [i * i for i in range(20)]
+
+    def test_fan_in_two_producers(self):
+        ch = make_channel(capacity=2)
+        done = make_channel(capacity=2)
+
+        def producer(tag):
+            for i in range(5):
+                channel_send(ch, (tag, i))
+            channel_send(done, tag)
+
+        go(producer, "a")
+        go(producer, "b")
+        finished = 0
+        got = []
+        while finished < 2:
+            sel = Select() \
+                .case("recv", ch, callback=lambda v, ok: got.append(v)) \
+                .case("recv", done,
+                      callback=lambda v, ok: got.append(("done", v)))
+            idx = sel.run(timeout=10)
+            if idx == 1:
+                finished += 1
+        # drain any stragglers
+        while True:
+            item = ch.try_recv()
+            if not item or not item[1]:
+                break
+            got.append(item[0])
+        vals = [g for g in got if g and g[0] in ("a", "b")]
+        assert len(vals) == 10
+        for tag in ("a", "b"):
+            assert [i for t, i in vals if t == tag] == list(range(5))
+
+    def test_channel_fed_training(self):
+        """End-to-end: an IO goroutine streams minibatches through a
+        channel into a training loop — the host-side role the reference's
+        in-graph channels actually served."""
+        ch = make_channel(capacity=2)
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 1).astype(np.float32)
+
+        def loader():
+            for _ in range(30):
+                xs = rng.randn(32, 8).astype(np.float32)
+                channel_send(ch, (xs, xs @ w))
+            channel_close(ch)
+
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+
+        go(loader)
+        last = None
+        while True:
+            batch, ok = channel_recv(ch)
+            if not ok:
+                break
+            l, = exe.run(feed={"x": batch[0], "y": batch[1]},
+                         fetch_list=[loss])
+            last = float(l[0])
+        assert last is not None and last < 0.05
+
+
+class TestSelect:
+    def test_select_picks_ready_case(self):
+        a, b = make_channel(capacity=1), make_channel(capacity=1)
+        channel_send(b, "bee")
+        hits = []
+        idx = Select() \
+            .case("recv", a, callback=lambda v, ok: hits.append(("a", v))) \
+            .case("recv", b, callback=lambda v, ok: hits.append(("b", v))) \
+            .run(timeout=5)
+        assert idx == 1 and hits == [("b", "bee")]
+
+    def test_select_default_when_nothing_ready(self):
+        a = make_channel(capacity=1)
+        hits = []
+        idx = Select() \
+            .case("recv", a) \
+            .default(lambda: hits.append("default")) \
+            .run()
+        assert idx == -1 and hits == ["default"]
+
+    def test_select_send_case(self):
+        a = make_channel(capacity=1)
+        idx = Select().case("send", a, value=7).run(timeout=5)
+        assert idx == 0
+        assert channel_recv(a) == (7, True)
+
+    def test_select_blocks_then_fires(self):
+        a = make_channel(capacity=1)
+
+        def later():
+            time.sleep(0.05)
+            channel_send(a, "late")
+
+        go(later)
+        t0 = time.monotonic()
+        idx = Select().case("recv", a).run(timeout=10)
+        assert idx == 0 and time.monotonic() - t0 >= 0.04
+
+    def test_empty_select_raises(self):
+        with pytest.raises(ValueError):
+            Select().run()

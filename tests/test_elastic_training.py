@@ -62,19 +62,26 @@ def test_sigkill_mid_pass_job_finishes_and_matches_oracle(tmp_path):
         procs = {}
         if pass_no == 0:
             # three workers; w0 is slowed so the parent can SIGKILL it
-            # reliably mid-task (a real preemption, not a clean exit)
+            # reliably mid-task (a real preemption, not a clean exit).
+            # w0 starts ALONE and the parent waits for its lease marker
+            # before spawning the fast workers — on a 1-core box the
+            # fast pair can otherwise drain the whole pass before the
+            # slow worker's spawn even finishes (observed in-suite).
             marker = str(tmp_path / "w0_started")
-            for wid in ("w0", "w1", "w2"):
-                log = str(tmp_path / f"log_{wid}_{pass_no}.json")
-                kw = {"slow_s": 1.0, "marker_path": marker} \
-                    if wid == "w0" else {}
-                procs[wid] = _spawn(ctx, wid, qdir, data_path,
-                                    params_path, grads, log, **kw)
-                logs.append((wid, log))
+            log0 = str(tmp_path / f"log_w0_{pass_no}.json")
+            procs["w0"] = _spawn(ctx, "w0", qdir, data_path, params_path,
+                                 grads, log0, slow_s=30.0,
+                                 marker_path=marker)
+            logs.append(("w0", log0))
             deadline = time.time() + 60
             while not os.path.exists(marker) and time.time() < deadline:
                 time.sleep(0.02)
             assert os.path.exists(marker), "w0 never leased a task"
+            for wid in ("w1", "w2"):
+                log = str(tmp_path / f"log_{wid}_{pass_no}.json")
+                procs[wid] = _spawn(ctx, wid, qdir, data_path,
+                                    params_path, grads, log)
+                logs.append((wid, log))
             os.kill(procs["w0"].pid, signal.SIGKILL)
             procs["w0"].join(timeout=30)
             assert procs["w0"].exitcode == -signal.SIGKILL

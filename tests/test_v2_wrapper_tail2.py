@@ -1,0 +1,162 @@
+"""Oracle tests for the second r5 v2 wrapper tranche (multiplex, row_conv,
+spp, block_expand, conv_shift, seq_slice/sub_seq, kmax_seq_score,
+get_output, cross_entropy_with_selfnorm, lambda_cost) and the F15
+channel surface re-export."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu.v2 import layer as v2l
+
+
+def _run(fetch, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with executor_mod.scope_guard(executor_mod.Scope()):
+        exe.run(fluid.default_startup_program())
+        outs = exe.run(feed=feed, fetch_list=list(fetch))
+    return [np.asarray(o) for o in outs]
+
+
+def _data(name, shape, dtype="float32"):
+    return fluid.layers.data(name=name, shape=shape, dtype=dtype,
+                             append_batch_size=False)
+
+
+RNG = np.random.RandomState(11)
+
+
+class TestTrancheTwo:
+    def test_multiplex(self):
+        a, b = _data("a", [4, 3]), _data("b", [4, 3])
+        idx = _data("idx", [4, 1], dtype="int64")
+        out = v2l.multiplex([a, b], index=idx)
+        av = RNG.randn(4, 3).astype(np.float32)
+        bv = RNG.randn(4, 3).astype(np.float32)
+        iv = np.array([[0], [1], [1], [0]], np.int64)
+        got, = _run([out], {"a": av, "b": bv, "idx": iv})
+        want = np.where(iv == 0, av, bv)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_spp_output_width(self):
+        img = _data("img", [2, 3, 8, 8])
+        out = v2l.spp(img, pyramid_height=2)
+        got, = _run([out], {"img": RNG.randn(2, 3, 8, 8)
+                            .astype(np.float32)})
+        # pyramid levels 1x1 + 2x2 = 5 bins per channel
+        assert got.shape == (2, 3 * 5)
+
+    def test_block_expand_shapes(self):
+        img = _data("img", [2, 1, 4, 6])
+        out = v2l.block_expand(img, block_x=3, block_y=2, stride_x=3,
+                               stride_y=2)
+        got, = _run([out], {"img": RNG.randn(2, 1, 4, 6)
+                            .astype(np.float32)})
+        # (4/2) * (6/3) = 4 blocks per image, each 1*2*3=6 wide
+        assert got.shape[-1] == 6
+        assert got.shape[0] == 2 * 4
+
+    def test_conv_shift_circular_correlation(self):
+        a, b = _data("a", [2, 5]), _data("b", [2, 3])
+        av = RNG.randn(2, 5).astype(np.float32)
+        bv = RNG.randn(2, 3).astype(np.float32)
+        got, = _run([v2l.conv_shift(a, b)], {"a": av, "b": bv})
+        want = np.zeros_like(av)
+        half = 1
+        for n in range(2):
+            for i in range(5):
+                for j in range(3):
+                    want[n, i] += av[n, (i + j - half) % 5] * bv[n, j]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_row_conv_shapes_and_params(self):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32",
+                              lod_level=1)
+        out = v2l.row_conv(x, context_len=3)
+        params = [tuple(v.shape) for v in
+                  fluid.default_startup_program().global_block()
+                  .vars.values() if getattr(v, "persistable", False)]
+        assert (3, 6) in params, params   # [context_len, D] exactly
+
+    def test_get_output(self):
+        assert v2l.get_output(("h", "c"), 1) == "c"
+        assert v2l.get_output(("h", "c")) == "h"
+        assert v2l.get_output("only") == "only"
+
+    def test_cross_entropy_with_selfnorm(self):
+        p = _data("p", [3, 4])
+        lab = _data("lab", [3, 1], dtype="int64")
+        probs = np.full((3, 4), 0.25, np.float32) * np.array(
+            [[2.0], [1.0], [0.5]], np.float32)   # rows sum to 2, 1, .5
+        labs = np.array([[0], [1], [2]], np.int64)
+        got, = _run([v2l.cross_entropy_with_selfnorm(
+            p, lab, softmax_selfnorm_alpha=0.5)], {"p": probs, "lab": labs})
+        ce = -np.log(probs[np.arange(3), labs.ravel()])
+        z = probs.sum(1)
+        want = (ce + 0.5 * np.log(z) ** 2).mean()
+        np.testing.assert_allclose(float(got.ravel()[0]), want, rtol=1e-4)
+
+    def test_lambda_cost_prefers_better_ranking(self):
+        """The LambdaRank cost must be lower when predicted scores agree
+        with the relevance ordering than when they invert it."""
+        pred = _data("pred", [2, 6])
+        rel = _data("rel", [2, 6])
+        cost = v2l.lambda_cost(pred, rel, NDCG_num=4)
+        rel_v = np.tile(np.array([3, 2, 1, 0, 0, 0], np.float32), (2, 1))
+        good = np.tile(np.linspace(3, -2, 6).astype(np.float32), (2, 1))
+        bad = good[:, ::-1].copy()
+        c_good, = _run([cost], {"pred": good, "rel": rel_v})
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            pred2 = _data("pred", [2, 6])
+            rel2 = _data("rel", [2, 6])
+            cost2 = v2l.lambda_cost(pred2, rel2, NDCG_num=4)
+            c_bad, = _run([cost2], {"pred": bad, "rel": rel_v})
+        assert float(c_good.ravel()[0]) < float(c_bad.ravel()[0])
+        assert np.isfinite(c_good).all() and np.isfinite(c_bad).all()
+
+    def test_seq_slice(self):
+        from paddle_tpu.executor import LoDTensor
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        off = _data("off", [2, 1], dtype="int64")
+        end = _data("end", [2, 1], dtype="int64")
+        out = v2l.seq_slice(x, off, end)        # ends are END positions
+        rows = np.arange(14, dtype=np.float32).reshape(7, 2)
+        feed = {"x": LoDTensor(rows, [[0, 3, 7]]),
+                "off": np.array([[1], [0]], np.int64),
+                "end": np.array([[3], [2]], np.int64)}
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(fluid.default_startup_program())
+            got, = exe.run(feed=feed, fetch_list=[out])
+        want = np.concatenate([rows[1:3], rows[3:5]])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_kmax_seq_score(self):
+        from paddle_tpu.executor import LoDTensor
+        x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                              lod_level=1)
+        out = v2l.kmax_seq_score(x, beam_size=2)
+        scores = np.array([[0.1], [0.9], [0.5],      # seq 1
+                           [0.7], [0.2], [0.4], [0.8]],  # seq 2
+                          np.float32)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(fluid.default_startup_program())
+            got, = exe.run(feed={"x": LoDTensor(scores, [[0, 3, 7]])},
+                           fetch_list=[out])
+        got = np.asarray(got)
+        assert got.shape[-1] == 2
+        assert list(got[0]) == [1, 2]          # 0.9, 0.5
+        assert list(got[1]) == [3, 0]          # 0.8, 0.7
+
+
+class TestChannelExports:
+    def test_fluid_surface(self):
+        ch = fluid.make_channel(capacity=1)
+        fluid.channel_send(ch, 5)
+        assert fluid.channel_recv(ch) == (5, True)
+        fluid.channel_close(ch)
+        assert fluid.channel_recv(ch) == (None, False)
+        assert callable(fluid.Go) and fluid.Select is not None
