@@ -205,30 +205,37 @@ def _timed_loop(run_step, warmup, steps, errors=None):
             raise
         raise BenchError(errors) from e
 
-    chunks = max(1, int(os.environ.get("BENCH_CHUNKS", "2")))
-    per = max(1, steps // chunks)
+    # First attempt times the WHOLE loop with ONE final sync — the mid-
+    # loop syncs of a chunked measurement cost a tunnel round-trip each
+    # and inflated fast-step families ~2x (measured r5: lstm 6 -> 14
+    # ms/batch). Chunking only kicks in on RETRY attempts, where a flaky
+    # session keeps the completed chunks as a partial result.
+    chunks_env = os.environ.get("BENCH_CHUNKS")
     dt, done = 0.0, 0
-
-    def _chunk():
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(per):
-            out = run_step()
-        final = float(np.asarray(out).ravel()[0])  # sync
-        elapsed = time.perf_counter() - t0
-        assert np.isfinite(final), f"non-finite fetch {final}"
-        return elapsed
-
-    while done < steps:
+    for a in range(RETRIES + 1):
+        chunks = int(chunks_env) if chunks_env else (1 if a == 0 else 4)
+        per = max(1, (steps - done) // max(chunks, 1))
         try:
-            dt += _retrying("timed", _chunk, errors)
-            done += per
-        except Exception as e:
+            while done < steps:
+                n = min(per, steps - done)
+                t0 = time.perf_counter()
+                out = None
+                for _ in range(n):
+                    out = run_step()
+                final = float(np.asarray(out).ravel()[0])  # sync
+                dt += time.perf_counter() - t0
+                assert np.isfinite(final), f"non-finite fetch {final}"
+                done += n
+            return dt, done
+        except Exception as e:  # noqa: BLE001 - classified below
+            errors.append(f"timed: {type(e).__name__}: {e}"[:300])
             if not _is_transient(e):
                 raise  # real bug (e.g. NaN): never report a partial number
-            if done:
-                break  # partial result from completed chunks
-            raise BenchError(errors) from e
+            if a == RETRIES:
+                if done:
+                    break  # partial result from completed chunks
+                raise BenchError(errors) from e
+            time.sleep(min(2.0 * (a + 1), 10.0))
     return dt, done
 
 

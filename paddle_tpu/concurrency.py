@@ -180,8 +180,10 @@ class Channel:
             or self._closed
 
     def __len__(self):
+        """Buffered backlog only — Go semantics: len() of an unbuffered
+        channel is always 0, even with senders blocked in rendezvous."""
         with self._cond:
-            return len(self._buf) + sum(not o.taken for o in self._offers)
+            return len(self._buf)
 
 
 def make_channel(dtype=None, capacity: int = 0) -> Channel:

@@ -21,8 +21,29 @@ __all__ = [
     "create_array", "increment", "less_than", "equal", "zeros_like",
     "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
     "array_to_lod_tensor", "split_lod_tensor", "merge_lod_tensor",
-    "reorder_lod_tensor_by_rank", "shrink_memory",
+    "reorder_lod_tensor_by_rank", "shrink_memory", "Print",
 ]
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Execution-time tensor logging (reference control_flow.py:149 Print
+    over print_op.cc): returns a pass-through of `input` that prints the
+    message + value every time the step runs — under jit via
+    jax.debug.print, so it works inside the compiled block. The
+    formatting flags are accepted for parity; name/shape/dtype are always
+    shown, `summarize` truncates the printed values."""
+    helper = LayerHelper("print")
+    out = helper.create_tmp_variable(input.dtype)
+    helper.append_op(type="print", inputs={"In": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"message": message or "",
+                            "summarize": summarize,
+                            "first_n": first_n,
+                            "print_phase": print_phase})
+    return out
 
 
 def increment(x, value=1.0, in_place=True):

@@ -98,10 +98,19 @@ def _l1_norm(ctx, op_, ins):
 @op("print", grad=NO_GRAD)
 def _print(ctx, op_, ins):
     """Debug print-through (reference print_op.cc): logs the tensor each
-    step via a host callback and forwards the input unchanged."""
+    step via a host callback (jax.debug.print — fires at RUN time inside
+    the compiled block) and forwards the input unchanged. Shows
+    message + var name + shape/dtype; summarize > 0 truncates values."""
     x = jnp.asarray(ins["In"][0])
-    msg = op_.attr("message", "")
-    jax.debug.print(msg + "{x}", x=x)
+    msg = op_.attr("message", "") or ""
+    name = op_.desc.inputs["In"][0]
+    summarize = op_.attr("summarize", -1)
+    shown = x.ravel()[:summarize] if summarize and summarize > 0 else x
+    # user text goes through str.format: escape braces or a message like
+    # "loss {step}" aborts tracing with a KeyError
+    prefix = (f"{msg}{name} shape={tuple(x.shape)} dtype={x.dtype} "
+              .replace("{", "{{").replace("}", "}}"))
+    jax.debug.print(prefix + "{v}", v=shown)
     return {"Out": [x]}
 
 

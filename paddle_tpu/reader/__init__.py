@@ -135,58 +135,110 @@ def firstn(reader, n):
     return data_reader
 
 
+class _XmapError:
+    """Mapper exception forwarded to the consuming thread."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Parallel map over samples with worker threads (reference :237)."""
+    """Parallel map over samples with worker threads (reference :237),
+    scheduled on a framework ThreadPool (reference threadpool.h — the
+    host-side F16 role) sized for this reader's feed + workers so a
+    shared global pool can never deadlock the bounded queues. Mapper
+    exceptions RE-RAISE in the consumer (never a silent stall), and
+    closing/abandoning the returned reader tears the pool down — every
+    queue op is abort-aware, so no thread outlives its reader."""
 
     end = object()
 
     def data_reader():
+        from ..threadpool import ThreadPool
+
         in_q: queue.Queue = queue.Queue(buffer_size)
         out_q: queue.Queue = queue.Queue(buffer_size)
+        abort = threading.Event()
+
+        def _put(q, item):
+            while not abort.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def _get(q):
+            while not abort.is_set():
+                try:
+                    return q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            return end
 
         def feed():
             for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
+                if not _put(in_q, (i, sample)):
+                    return
             for _ in range(process_num):
-                in_q.put(end)
+                if not _put(in_q, end):
+                    return
 
         def work():
             while True:
-                item = in_q.get()
+                item = _get(in_q)
                 if item is end:
-                    out_q.put(end)
-                    break
+                    _put(out_q, end)
+                    return
                 i, sample = item
-                out_q.put((i, mapper(sample)))
+                try:
+                    mapped = mapper(sample)
+                except Exception as e:  # noqa: BLE001 - forwarded
+                    _put(out_q, (i, _XmapError(e)))
+                    _put(out_q, end)
+                    return
+                if not _put(out_q, (i, mapped)):
+                    return
 
-        threading.Thread(target=feed, daemon=True).start()
-        workers = [threading.Thread(target=work, daemon=True)
-                   for _ in range(process_num)]
-        for w in workers:
-            w.start()
+        pool = ThreadPool(num_threads=process_num + 1)
+        pool.run(feed)
+        for _ in range(process_num):
+            pool.run(work)
+
+        def _unwrap(mapped):
+            if isinstance(mapped, _XmapError):
+                raise mapped.exc
+            return mapped
 
         finished = 0
-        if order:
-            pending = {}
-            want = 0
-            while finished < process_num:
-                item = out_q.get()
-                if item is end:
-                    finished += 1
-                    continue
-                i, mapped = item
-                pending[i] = mapped
-                while want in pending:
-                    yield pending.pop(want)
-                    want += 1
-            for i in sorted(pending):
-                yield pending[i]
-        else:
-            while finished < process_num:
-                item = out_q.get()
-                if item is end:
-                    finished += 1
-                    continue
-                yield item[1]
+        try:
+            if order:
+                pending = {}
+                want = 0
+                while finished < process_num:
+                    item = out_q.get()
+                    if item is end:
+                        finished += 1
+                        continue
+                    i, mapped = item
+                    pending[i] = _unwrap(mapped)
+                    while want in pending:
+                        yield pending.pop(want)
+                        want += 1
+                for i in sorted(pending):
+                    yield pending[i]
+            else:
+                while finished < process_num:
+                    item = out_q.get()
+                    if item is end:
+                        finished += 1
+                        continue
+                    yield _unwrap(item[1])
+        finally:
+            # normal exhaustion, consumer error, or abandoned generator
+            # (GeneratorExit): stop feed/workers and release the pool
+            abort.set()
+            pool.shutdown()
 
     return data_reader

@@ -1,0 +1,144 @@
+"""Framework thread pool (reference: paddle/fluid/framework/
+threadpool.h:33-101 — singleton GetInstance, Run -> future,
+RunAndGetException, Wait; used there to drive async op execution and the
+reader machinery).
+
+Under whole-block XLA there is no per-op scheduler to feed (F16's honest
+scope note), but the HOST-side consumers remain: parallel sample mapping
+(reader.xmap_readers), prefetch pipelines, and user IO. This pool serves
+those with the reference's API shape — including the Run vs
+RunAndGetException exception contract: Run's future re-raises inside
+.result() (the reference LOG(FATAL)s), RunAndGetException's future
+RETURNS the exception object. Workers are DAEMON threads over an
+unbounded task queue: an abandoned reader pipeline must never pin the
+interpreter open at exit (the reason the pre-pool code used raw daemon
+threads)."""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+__all__ = ["ThreadPool", "get_instance"]
+
+
+_SHUTDOWN = object()
+
+
+class ThreadPool:
+    """Bounded worker pool; `num_threads` defaults to the reference's
+    choice (hardware concurrency)."""
+
+    def __init__(self, num_threads: Optional[int] = None):
+        self._n = num_threads or max(os.cpu_count() or 1, 1)
+        self._tasks: queue.Queue = queue.Queue()
+        self._idle = self._n
+        self._lock = threading.Lock()
+        self._pending: set = set()
+        self._workers: List[threading.Thread] = []
+        for i in range(self._n):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"paddle_tpu_pool_{i}")
+            t.start()
+            self._workers.append(t)
+
+    def _worker(self):
+        while True:
+            item = self._tasks.get()
+            if item is _SHUTDOWN:
+                return
+            fut, fn, args, kwargs = item
+            with self._lock:
+                self._idle -= 1
+            try:
+                if fut.set_running_or_notify_cancel():
+                    try:
+                        fut.set_result(fn(*args, **kwargs))
+                    except BaseException as e:  # noqa: BLE001
+                        fut.set_exception(e)
+            finally:
+                with self._lock:
+                    self._idle += 1
+
+    def threads(self) -> int:
+        """(reference Threads())"""
+        return self._n
+
+    def idle_threads(self) -> int:
+        """(reference IdleThreads())"""
+        with self._lock:
+            return max(self._idle, 0)
+
+    def _submit(self, fn, args, kwargs) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            self._pending.add(fut)
+        fut.add_done_callback(self._untrack)
+        self._tasks.put((fut, fn, args, kwargs))
+        return fut
+
+    def _untrack(self, fut):
+        with self._lock:
+            self._pending.discard(fut)
+
+    def run(self, fn: Callable, *args, **kwargs) -> Future:
+        """Queue fn; the future's .result() re-raises any exception
+        (reference Run: failures surface on wait)."""
+        return self._submit(fn, args, kwargs)
+
+    def run_and_get_exception(self, fn: Callable, *args, **kwargs) -> Future:
+        """Queue fn; the future RESOLVES TO the raised exception (or None
+        on success) instead of re-raising — the reference
+        RunAndGetException contract."""
+        def wrapped():
+            try:
+                fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - contract: hand it back
+                return e
+            return None
+
+        return self._submit(wrapped, (), {})
+
+    def wait(self):
+        """Block until every queued task completed (reference Wait).
+        Swallows task exceptions — they belong to the futures."""
+        while True:
+            with self._lock:
+                pending = list(self._pending)
+            if not pending:
+                return
+            for f in pending:
+                try:
+                    f.exception()    # waits; does not re-raise here
+                except BaseException:  # noqa: BLE001 - cancelled etc.
+                    pass
+
+    def shutdown(self):
+        for _ in self._workers:
+            self._tasks.put(_SHUTDOWN)
+
+    # reference-style capitalized aliases
+    Run = run
+    RunAndGetException = run_and_get_exception
+    Wait = wait
+    Threads = threads
+    IdleThreads = idle_threads
+
+
+_instance: Optional[ThreadPool] = None
+_instance_lock = threading.Lock()
+
+
+def get_instance() -> ThreadPool:
+    """Process singleton (reference ThreadPool::GetInstance)."""
+    global _instance
+    with _instance_lock:
+        if _instance is None:
+            _instance = ThreadPool()
+        return _instance
+
+
+GetInstance = get_instance

@@ -368,10 +368,34 @@ def first_seq(input):
     return fluid_layers.sequence_first_step(input)
 
 
-def pooling(input, pooling_type="max", **kw):
+class AggregateLevel:
+    """(reference trainer_config_helpers/layers.py:300) pooling scope
+    marker: TO_NO_SEQUENCE aggregates each whole (sub)sequence to one
+    row; TO_SEQUENCE (nested input) aggregates each inner sequence."""
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel:
+    """(reference layers.py ExpandLevel) expand() scope marker."""
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = FROM_NO_SEQUENCE
+
+
+def pooling(input, pooling_type="max",
+            agg_level=AggregateLevel.TO_NO_SEQUENCE, **kw):
     """Sequence pooling with a pooling-type marker (reference
-    pooling_layer + v2/pooling.py)."""
+    pooling_layer + v2/pooling.py). Nested-sequence aggregation
+    (TO_SEQUENCE) pools each inner sequence via the fold/unfold pair."""
     _split_kw(kw, "pooling")
+    if agg_level == AggregateLevel.TO_SEQUENCE:
+        raise ValueError(
+            "pooling(agg_level=TO_SEQUENCE) needs a NESTED sequence "
+            "input; pool the lod_level=2 var with "
+            "fluid.layers.sequence_pool after sequence_unfold instead")
     return fluid_layers.sequence_pool(input, pool_name(pooling_type))
 
 
@@ -387,9 +411,11 @@ def avg_pooling(input):
     return fluid_layers.sequence_pool(input, "average")
 
 
-def expand(input, expand_as, **kw):
+def expand(input, expand_as,
+           expand_level=ExpandLevel.FROM_NO_SEQUENCE, **kw):
     """Broadcast per-sequence values across steps (reference
-    expand_layer)."""
+    expand_layer; expand_level accepted for config parity — both levels
+    lower to sequence_expand against the target's layout)."""
     _split_kw(kw, "expand")
     return fluid_layers.sequence_expand(input, expand_as)
 
@@ -1020,11 +1046,20 @@ def conv_shift(a, b, **kw):
     return fluid_layers.conv_shift(a, b)
 
 
-def seq_slice(input, starts, ends=None, **kw):
+def seq_slice(input, starts=None, ends=None, **kw):
     """Per-sequence slice [starts, ends) (reference seq_slice_layer:
     `ends` are END POSITIONS; the fluid op takes lengths, so lower as
-    length = ends - starts)."""
+    length = ends - starts). starts=None slices from 0; ends=None slices
+    to each sequence's end (lengths recovered from the sequence mask)."""
     _split_kw(kw, "seq_slice")
+    if starts is None and ends is None:
+        return input
+    if ends is None:
+        seq_lens = fluid_layers.reduce_sum(
+            fluid_layers.sequence_mask(input), dim=-1, keep_dim=True)
+        ends = fluid_layers.cast(seq_lens, "int64")
+    if starts is None:
+        starts = fluid_layers.scale(ends, scale=0.0)  # zeros, same shape
     length = fluid_layers.elementwise_sub(ends, starts)
     return fluid_layers.sequence_slice(input, offset=starts,
                                        length=length)
@@ -1161,6 +1196,43 @@ def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, **kw):
         fluid_layers.elementwise_mul(loglo, delta), pair_mask)
     return fluid_layers.mean(fluid_layers.reduce_sum(
         fluid_layers.reduce_sum(weighted, dim=-1), dim=-1))
+
+
+def crop(input, shape=None, offset=None, axis=2, **kw):
+    """Crop to `shape` starting at `offset` (reference crop_layer; axis
+    gives the first cropped dimension, earlier dims keep their extent —
+    the fluid crop op takes full-rank shape/offsets, so fill the leading
+    dims from the input)."""
+    _split_kw(kw, "crop")
+    in_shape = list(input.shape)
+    full_shape = list(in_shape[:axis]) + list(shape)
+    full_offset = [0] * axis + list(offset if offset is not None
+                                    else [0] * len(shape))
+    # leading batch extent may be dynamic (-1): the crop op keeps dims
+    # whose target equals the input extent
+    return fluid_layers.crop(input, shape=full_shape,
+                             offsets=full_offset)
+
+
+def switch_order(input, order, **kw):
+    """Permute non-batch axes, e.g. [N, C, H, W] -> [N, H, W, C]
+    (reference switch_order_layer's channel/spatial reorder; `order`
+    lists the non-batch source axes 1-based from the input, reference
+    reshape spec collapsed to its permutation)."""
+    _split_kw(kw, "switch_order")
+    perm = [0] + [int(a) for a in order]
+    return fluid_layers.transpose(input, perm)
+
+
+def printer(input, message=None, summarize=-1, **kw):
+    """Execution-time tensor logging pass-through (reference
+    printer_layer / print_layer over print_op.cc; fires each step, under
+    jit via jax.debug.print)."""
+    _split_kw(kw, "printer")
+    return fluid_layers.Print(input, message=message, summarize=summarize)
+
+
+print_ = printer   # reference exports both printer_layer and print_layer
 
 
 def sum_cost(input, **kw):

@@ -78,14 +78,16 @@ def test_once_raising_step_still_yields_number(bench, monkeypatch):
 
 
 def test_partial_chunks_survive_persistent_failure(bench, monkeypatch):
-    """A late persistent failure keeps the completed chunks: the round
-    still gets a number from the steps that ran."""
-    monkeypatch.setattr(bench, "RETRIES", 1)
-    # chunk size = steps//2 = 2: chunk 1 (calls 2-3) succeeds, chunk 2
-    # always dies -> partial result, not an exception
-    step = _FlakyStep(fail_on={4, 5, 6, 7, 8, 9, 10})
+    """A late persistent failure keeps the chunks completed by RETRY
+    attempts (attempt 0 is single-sync for clean timing; retries chunk
+    so progress accumulates): the round still gets a number."""
+    monkeypatch.setattr(bench, "RETRIES", 2)
+    # warmup call 1; attempt0 single chunk: calls 2,3,4 -> call4 dies;
+    # attempt1 (chunks of 1): call5 OK (done=1), call6 dies;
+    # attempt2: call7 dies -> budget gone, partial done=1 survives
+    step = _FlakyStep(fail_on={4, 6, 7, 8, 9, 10})
     dt, done = bench._timed_loop(step, warmup=1, steps=4)
-    assert done == 2 and dt > 0
+    assert done == 1 and dt > 0
 
 
 def test_persistent_warmup_failure_raises_bench_error(bench, monkeypatch):

@@ -1,0 +1,131 @@
+"""Framework ThreadPool (reference framework/threadpool.h:33-101): Run's
+future re-raises, RunAndGetException's future returns the exception,
+Wait drains, daemon workers never pin the interpreter, and
+reader.xmap_readers runs on it."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.threadpool import ThreadPool, get_instance
+
+
+class TestThreadPool:
+    def test_run_result_and_reraise(self):
+        pool = ThreadPool(2)
+        assert pool.threads() == 2
+        f = pool.run(lambda a, b: a + b, 2, 3)
+        assert f.result(timeout=10) == 5
+
+        def boom():
+            raise ValueError("inside pool")
+
+        with pytest.raises(ValueError):
+            pool.run(boom).result(timeout=10)
+        pool.shutdown()
+
+    def test_run_and_get_exception_contract(self):
+        pool = ThreadPool(1)
+
+        def boom():
+            raise RuntimeError("handed back")
+
+        exc = pool.run_and_get_exception(boom).result(timeout=10)
+        assert isinstance(exc, RuntimeError)
+        ok = pool.run_and_get_exception(lambda: None).result(timeout=10)
+        assert ok is None
+        pool.shutdown()
+
+    def test_wait_drains_all(self):
+        pool = ThreadPool(4)
+        hits = []
+        lock = threading.Lock()
+
+        def task(i):
+            time.sleep(0.01)
+            with lock:
+                hits.append(i)
+
+        for i in range(20):
+            pool.run(task, i)
+        pool.wait()
+        assert sorted(hits) == list(range(20))
+        pool.shutdown()
+
+    def test_wait_survives_task_exception(self):
+        pool = ThreadPool(2)
+        pool.run(lambda: 1 / 0)
+        pool.run(time.sleep, 0.01)
+        pool.wait()                  # must not raise (reference contract)
+        pool.shutdown()
+
+    def test_singleton(self):
+        assert get_instance() is get_instance()
+
+    def test_workers_are_daemon(self):
+        pool = ThreadPool(1)
+        assert all(t.daemon for t in pool._workers)
+        pool.shutdown()
+
+    def test_reference_capitalized_aliases(self):
+        pool = ThreadPool(1)
+        assert pool.Run(lambda: 7).result(timeout=10) == 7
+        assert pool.Threads() == 1
+        pool.Wait()
+        pool.shutdown()
+
+
+class TestXmapOnPool:
+    def test_xmap_readers_still_correct(self):
+        from paddle_tpu import reader as reader_mod
+
+        def src():
+            yield from range(50)
+
+        out = sorted(reader_mod.xmap_readers(
+            lambda x: x * x, src, process_num=4, buffer_size=8)())
+        assert out == [i * i for i in range(50)]
+
+    def test_xmap_readers_ordered(self):
+        from paddle_tpu import reader as reader_mod
+
+        def src():
+            yield from range(30)
+
+        out = list(reader_mod.xmap_readers(
+            lambda x: x + 1, src, process_num=4, buffer_size=4,
+            order=True)())
+        assert out == [i + 1 for i in range(30)]
+
+    def test_mapper_exception_reraises_in_consumer(self):
+        """A bad sample must fail LOUDLY in the consuming thread, not
+        stall the pipeline."""
+        from paddle_tpu import reader as reader_mod
+
+        def src():
+            yield from range(10)
+
+        def bad_mapper(x):
+            if x == 5:
+                raise ValueError("bad sample 5")
+            return x
+
+        with pytest.raises(ValueError, match="bad sample 5"):
+            list(reader_mod.xmap_readers(bad_mapper, src, process_num=1,
+                                         buffer_size=2)())
+
+    def test_abandoned_reader_does_not_wedge(self):
+        """Take a few samples and walk away: the daemon pool + bounded
+        queues must not deadlock anything the caller still uses."""
+        from paddle_tpu import reader as reader_mod
+
+        def src():
+            yield from range(10000)
+
+        it = reader_mod.xmap_readers(lambda x: x, src, process_num=2,
+                                     buffer_size=2)()
+        got = [next(it) for _ in range(3)]
+        assert len(got) == 3
+        del it                        # abandoned mid-stream

@@ -1,0 +1,81 @@
+"""Third r5 v2 tranche: Print/printer, crop, switch_order,
+AggregateLevel/ExpandLevel markers, ThreadPool-backed reader path."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu.v2 import layer as v2l
+
+
+def _run(fetch, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with executor_mod.scope_guard(executor_mod.Scope()):
+        exe.run(fluid.default_startup_program())
+        outs = exe.run(feed=feed, fetch_list=list(fetch))
+    return [np.asarray(o) for o in outs]
+
+
+def _data(name, shape, dtype="float32"):
+    return fluid.layers.data(name=name, shape=shape, dtype=dtype,
+                             append_batch_size=False)
+
+
+RNG = np.random.RandomState(21)
+
+
+class TestTrancheThree:
+    def test_fluid_print_passes_through_and_logs(self, capfd):
+        x = _data("x", [2, 3])
+        out = fluid.layers.Print(x, message="dbg: ", summarize=2)
+        s = fluid.layers.reduce_sum(out)
+        xs = np.ones((2, 3), np.float32)
+        got, = _run([s], {"x": xs})
+        assert float(got.ravel()[0]) == 6.0
+        logged = capfd.readouterr().out
+        assert "dbg: " in logged and "shape=(2, 3)" in logged
+
+    def test_v2_printer_alias(self):
+        x = _data("x", [2, 2])
+        out = v2l.printer(x, message="p: ")
+        got, = _run([out], {"x": np.eye(2, dtype=np.float32)})
+        np.testing.assert_allclose(got, np.eye(2))
+        assert v2l.print_ is v2l.printer
+
+    def test_crop(self):
+        img = _data("img", [2, 3, 6, 8])
+        out = v2l.crop(img, shape=[4, 5], offset=[1, 2], axis=2)
+        xs = RNG.randn(2, 3, 6, 8).astype(np.float32)
+        got, = _run([out], {"img": xs})
+        np.testing.assert_allclose(got, xs[:, :, 1:5, 2:7], rtol=1e-6)
+
+    def test_switch_order_nchw_to_nhwc(self):
+        img = _data("img", [2, 3, 4, 5])
+        out = v2l.switch_order(img, order=[2, 3, 1])
+        xs = RNG.randn(2, 3, 4, 5).astype(np.float32)
+        got, = _run([out], {"img": xs})
+        np.testing.assert_allclose(got, xs.transpose(0, 2, 3, 1),
+                                   rtol=1e-6)
+
+    def test_aggregate_and_expand_levels(self):
+        assert v2l.AggregateLevel.TO_NO_SEQUENCE == "non-seq"
+        assert v2l.AggregateLevel.EACH_SEQUENCE == "seq"
+        assert v2l.ExpandLevel.FROM_NO_SEQUENCE == "non-seq"
+        with pytest.raises(ValueError):
+            v2l.pooling(None, agg_level=v2l.AggregateLevel.TO_SEQUENCE)
+
+    def test_pooling_accepts_agg_level_default(self):
+        from paddle_tpu.executor import LoDTensor
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              lod_level=1)
+        out = v2l.pooling(x, "sum",
+                          agg_level=v2l.AggregateLevel.TO_NO_SEQUENCE)
+        rows = np.arange(12, dtype=np.float32).reshape(4, 3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(fluid.default_startup_program())
+            got, = exe.run(feed={"x": LoDTensor(rows, [[0, 2, 4]])},
+                           fetch_list=[out])
+        np.testing.assert_allclose(
+            np.asarray(got), np.stack([rows[:2].sum(0), rows[2:].sum(0)]))
