@@ -12,7 +12,7 @@ from ..layer_helper import LayerHelper
 from ..initializer import ConstantInitializer
 
 __all__ = [
-    "sequence_unfold", "sequence_mask", "sequence_fold",
+    "sequence_unfold", "sequence_mask", "sequence_fold", "context_project",
     "dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit", "lstm_unit",
     "sequence_conv", "sequence_pool", "sequence_first_step",
     "sequence_last_step", "sequence_softmax", "sequence_expand",
@@ -301,4 +301,20 @@ def sequence_mask(x, name=None):
     out = helper.create_tmp_variable("float32")
     helper.append_op(type="sequence_mask", inputs={"X": [x]},
                      outputs={"Y": [out]})
+    return out
+
+
+def context_project(x, context_length, context_start=None, name=None):
+    """Concatenate a window of neighboring timesteps onto the feature
+    axis, zero-padded at sequence boundaries (reference gserver
+    ContextProjection; the centered default matches
+    trainer_config_helpers context_projection: start = -(L-1)//2)."""
+    if context_start is None:
+        context_start = -(context_length - 1) // 2
+    helper = LayerHelper("context_project", name=name)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="context_project", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"context_length": context_length,
+                            "context_start": context_start})
     return out

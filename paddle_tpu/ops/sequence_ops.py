@@ -683,6 +683,51 @@ def _sequence_fold(ctx, op_, ins):
     return {"Out": [out]}
 
 
+def _context_project_infer(op_, block):
+    xv = in_var(op_, block, "X")
+    if xv is not None and xv.shape is not None:
+        shape = list(xv.shape)
+        shape[-1] = shape[-1] * op_.attr("context_length", 1)
+        set_out(op_, block, "Out", shape, xv.dtype)
+
+
+@op("context_project", infer_shape=_context_project_infer)
+def _context_project(ctx, op_, ins):
+    """Concatenate a window of neighboring timesteps onto the feature
+    axis (reference gserver ContextProjection / trainer_config_helpers
+    context_projection): out[:, t] = [x[:, t+s], ..., x[:, t+s+L-1]] with
+    s = context_start, zero-padded outside each sequence. Linear in x, so
+    the generic vjp gives the exact gradient; per-sequence boundaries
+    come from the lengths side channel (padded-LoD convention)."""
+    x = jnp.asarray(ins["X"][0])                 # [B, T, D]
+    start = op_.attr("context_start", 0)
+    length = op_.attr("context_length", 1)
+    b, t, d = x.shape
+    lengths = _lengths(ctx, op_, "X")
+    steps = jnp.arange(t)[None, :]               # [1, T]
+    if lengths is None:
+        valid = jnp.ones((b, t), bool)
+    else:
+        valid = steps < jnp.asarray(lengths)[:, None]
+    # zero out padding rows first so shifts can never leak garbage
+    x = jnp.where(valid[..., None], x, 0.0)
+    pieces = []
+    for k in range(length):
+        shift = start + k                        # source offset per step
+        if shift < 0:
+            shifted = jnp.pad(x, ((0, 0), (-shift, 0), (0, 0)))[:, :t]
+        elif shift > 0:
+            shifted = jnp.pad(x, ((0, 0), (0, shift), (0, 0)))[:, shift:]
+        else:
+            shifted = x
+        # window positions past a sequence's end contribute zeros
+        src_ok = valid if lengths is None else \
+            ((steps + shift >= 0)
+             & (steps + shift < jnp.asarray(lengths)[:, None]))
+        pieces.append(jnp.where(src_ok[..., None], shifted, 0.0))
+    return {"Out": [jnp.concatenate(pieces, axis=-1)]}
+
+
 @op("sequence_mask", grad=NO_GRAD)
 def _sequence_mask(ctx, op_, ins):
     """Dense [B, T] validity mask from a padded sequence var's lengths

@@ -178,9 +178,16 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             return end
 
         def feed():
-            for i, sample in enumerate(reader()):
-                if not _put(in_q, (i, sample)):
-                    return
+            try:
+                for i, sample in enumerate(reader()):
+                    if not _put(in_q, (i, sample)):
+                        return
+            except Exception as e:  # noqa: BLE001 - forwarded like mapper
+                # a dying SOURCE must also fail loudly in the consumer:
+                # the error item re-raises there, whose teardown (abort)
+                # then releases the workers still blocked on in_q
+                _put(out_q, (-1, _XmapError(e)))
+                return
             for _ in range(process_num):
                 if not _put(in_q, end):
                     return

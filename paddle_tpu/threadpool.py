@@ -35,6 +35,7 @@ class ThreadPool:
     def __init__(self, num_threads: Optional[int] = None):
         self._n = num_threads or max(os.cpu_count() or 1, 1)
         self._tasks: queue.Queue = queue.Queue()
+        self._closed = False
         self._idle = self._n
         self._lock = threading.Lock()
         self._pending: set = set()
@@ -75,6 +76,10 @@ class ThreadPool:
     def _submit(self, fn, args, kwargs) -> Future:
         fut: Future = Future()
         with self._lock:
+            if self._closed:
+                raise RuntimeError(
+                    "ThreadPool is shut down — tasks queued now would "
+                    "never run and their futures would never resolve")
             self._pending.add(fut)
         fut.add_done_callback(self._untrack)
         self._tasks.put((fut, fn, args, kwargs))
@@ -117,6 +122,8 @@ class ThreadPool:
                     pass
 
     def shutdown(self):
+        with self._lock:
+            self._closed = True
         for _ in self._workers:
             self._tasks.put(_SHUTDOWN)
 

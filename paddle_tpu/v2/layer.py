@@ -388,8 +388,10 @@ class ExpandLevel:
 def pooling(input, pooling_type="max",
             agg_level=AggregateLevel.TO_NO_SEQUENCE, **kw):
     """Sequence pooling with a pooling-type marker (reference
-    pooling_layer + v2/pooling.py). Nested-sequence aggregation
-    (TO_SEQUENCE) pools each inner sequence via the fold/unfold pair."""
+    pooling_layer + v2/pooling.py). Only whole-sequence aggregation
+    (TO_NO_SEQUENCE, the default) is provided here; the nested
+    TO_SEQUENCE form raises with a pointer to the fluid fold/unfold
+    surface, which handles lod_level=2 explicitly."""
     _split_kw(kw, "pooling")
     if agg_level == AggregateLevel.TO_SEQUENCE:
         raise ValueError(
@@ -719,6 +721,43 @@ def scaling_projection(input, param_attr=None, **kw):
     return fluid_layers.elementwise_mul(input, w)
 
 
+def context_projection(input, context_len, context_start=None,
+                       padding_attr=False, **kw):
+    """Window of neighboring timesteps concatenated on the feature axis
+    (reference context_projection, trainer_config_helpers: default start
+    centers the window at -(L-1)/2; boundaries zero-pad). Trainable
+    boundary padding (padding_attr) is not carried — zero padding is the
+    reference default."""
+    _split_kw(kw, "context_projection")
+    if padding_attr not in (False, None):
+        import warnings
+        warnings.warn("context_projection: trainable boundary padding is "
+                      "not supported on this build; using zero padding",
+                      stacklevel=2)
+    return fluid_layers.context_project(input, context_len,
+                                        context_start)
+
+
+def gru_step(input, output_mem, size=None, act=None, gate_act=None,
+             param_attr=None, bias_attr=None, name=None, **kw):
+    """One GRU step for recurrent_group (reference gru_step_layer):
+    `input` is the [B, 3H] x-projection, `output_mem` the previous hidden
+    [B, H]; returns the new hidden (create with name= to pair with
+    memory())."""
+    ignored = _split_kw(kw, "gru_step", init_ok=True)
+    size = size or output_mem.shape[-1]
+    h, _reset, _gate = fluid_layers.gru_unit(
+        input, output_mem, size * 3,
+        param_attr=_attr_with_init(param_attr, ignored),
+        bias_attr=_as_attr(bias_attr),
+        activation=_act_name(act) or "tanh",
+        gate_activation=_act_name(gate_act) or "sigmoid")
+    return _register_named(name, h)
+
+
+gru_step_naive = gru_step   # reference exports both (same math here)
+
+
 def conv_projection(input, filter_size, num_filters, num_channels=None,
                     stride=1, padding=0, param_attr=None, **kw):
     """Convolution as a projection: no bias, no activation (reference
@@ -730,6 +769,68 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
                                param_attr=_attr_with_init(param_attr,
                                                           ignored),
                                bias_attr=False)
+
+
+def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
+                  stride=1, padding=0, **kw):
+    """Convolution inside mixed() (reference conv_operator: like
+    conv_projection but positioned as a two-input operator; the filter
+    input is accepted for signature parity — parameters are created
+    internally like every projection here)."""
+    _split_kw(kw, "conv_operator")
+    return conv_projection(img, filter_size=filter_size,
+                           num_filters=num_filters,
+                           num_channels=num_channels, stride=stride,
+                           padding=padding)
+
+
+def slice_projection(input, slices, **kw):
+    """Column slices concatenated (reference slice_projection:
+    slices = [(start, end), ...])."""
+    _split_kw(kw, "slice_projection")
+    parts = [identity_projection(input, offset=s, size=e - s)
+             for s, e in slices]
+    if len(parts) == 1:
+        return parts[0]
+    return fluid_layers.concat(parts, axis=-1)
+
+
+def img_conv3d(input, filter_size, num_filters, num_channels=None,
+               stride=1, padding=0, act=None, param_attr=None,
+               bias_attr=None, **kw):
+    """Volumetric convolution (reference img_conv3d_layer over fluid
+    conv3d)."""
+    ignored = _split_kw(kw, "img_conv3d", init_ok=True)
+    return fluid_layers.conv3d(input=input, num_filters=num_filters,
+                               filter_size=filter_size, stride=stride,
+                               padding=padding, act=_act_name(act),
+                               param_attr=_attr_with_init(param_attr,
+                                                          ignored),
+                               bias_attr=_as_attr(bias_attr))
+
+
+def img_pool3d(input, pool_size, stride=1, padding=0, pool_type="max",
+               **kw):
+    """Volumetric pooling (reference img_pool3d_layer over fluid
+    pool3d)."""
+    _split_kw(kw, "img_pool3d")
+    return fluid_layers.pool3d(input=input, pool_size=pool_size,
+                               pool_type=pool_name(pool_type),
+                               pool_stride=stride, pool_padding=padding)
+
+
+def priorbox(input, image, min_size, max_size=None, aspect_ratio=None,
+             variance=None, **kw):
+    """SSD prior boxes (reference priorbox_layer over the fluid
+    detection stack's prior_box)."""
+    _split_kw(kw, "priorbox")
+    from ..layers import detection as det
+    boxes, variances = det.prior_box(
+        input, image, min_sizes=list(min_size),
+        max_sizes=list(max_size) if max_size else None,
+        aspect_ratios=list(aspect_ratio) if aspect_ratio else None,
+        variance=list(variance) if variance else None)
+    return boxes, variances
 
 
 def mixed(size=None, input=None, act=None, bias_attr=None, name=None, **kw):
@@ -1204,6 +1305,11 @@ def crop(input, shape=None, offset=None, axis=2, **kw):
     the fluid crop op takes full-rank shape/offsets, so fill the leading
     dims from the input)."""
     _split_kw(kw, "crop")
+    if shape is None:
+        raise ValueError(
+            "crop() needs an explicit shape= (the reference's "
+            "infer-from-second-input form is not supported; pass the "
+            "target extents of the cropped dims)")
     in_shape = list(input.shape)
     full_shape = list(in_shape[:axis]) + list(shape)
     full_offset = [0] * axis + list(offset if offset is not None

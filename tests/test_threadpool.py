@@ -99,6 +99,24 @@ class TestXmapOnPool:
             order=True)())
         assert out == [i + 1 for i in range(30)]
 
+    def test_run_after_shutdown_raises(self):
+        pool = ThreadPool(1)
+        pool.shutdown()
+        with pytest.raises(RuntimeError, match="shut down"):
+            pool.run(lambda: 1)
+
+    def test_source_reader_exception_reraises_in_consumer(self):
+        """A dying SOURCE (not just mapper) must fail loudly too."""
+        from paddle_tpu import reader as reader_mod
+
+        def bad_src():
+            yield 1
+            raise IOError("corrupt shard")
+
+        with pytest.raises(IOError, match="corrupt shard"):
+            list(reader_mod.xmap_readers(lambda x: x, bad_src,
+                                         process_num=2, buffer_size=2)())
+
     def test_mapper_exception_reraises_in_consumer(self):
         """A bad sample must fail LOUDLY in the consuming thread, not
         stall the pipeline."""

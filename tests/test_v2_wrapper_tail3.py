@@ -65,6 +65,101 @@ class TestTrancheThree:
         with pytest.raises(ValueError):
             v2l.pooling(None, agg_level=v2l.AggregateLevel.TO_SEQUENCE)
 
+    def test_context_projection_oracle(self):
+        """Centered 3-window: out[t] = [x[t-1], x[t], x[t+1]] with zeros
+        outside each sequence (reference ContextProjection)."""
+        from paddle_tpu.executor import LoDTensor
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              lod_level=1)
+        out = v2l.context_projection(x, context_len=3)
+        rows = np.arange(1, 11, dtype=np.float32).reshape(5, 2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            exe.run(fluid.default_startup_program())
+            got, = exe.run(feed={"x": LoDTensor(rows, [[0, 3, 5]])},
+                           fetch_list=[out])
+        got = np.asarray(got)                   # packed [sum_len, 3*D]
+        z = np.zeros(2, np.float32)
+        seq1, seq2 = rows[:3], rows[3:]
+        want = np.stack([
+            np.concatenate([z, seq1[0], seq1[1]]),
+            np.concatenate([seq1[0], seq1[1], seq1[2]]),
+            np.concatenate([seq1[1], seq1[2], z]),
+            np.concatenate([z, seq2[0], seq2[1]]),
+            np.concatenate([seq2[0], seq2[1], z]),
+        ])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_gru_step_inside_recurrent_group_matches_dynamic_gru(self):
+        """gru_step + memory inside recurrent_group must reproduce
+        dynamic_gru given shared parameters."""
+        from paddle_tpu.executor import LoDTensor
+        H = 4
+        rows = RNG.randn(6, 3 * H).astype(np.float32)
+
+        def run(build):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="x", shape=[3 * H],
+                                      dtype="float32", lod_level=1)
+                out = build(x)
+            exe = fluid.Executor(fluid.CPUPlace())
+            sc = executor_mod.Scope()
+            with executor_mod.scope_guard(sc):
+                exe.run(startup)
+                got, = exe.run(main,
+                               feed={"x": LoDTensor(rows, [[0, 6]])},
+                               fetch_list=[out])
+            return np.asarray(got)
+
+        def via_group(x):
+            def step(x_t):
+                prev = v2l.memory("h", size=H)
+                return v2l.gru_step(x_t, prev, size=H, name="h",
+                                    param_attr="gw", bias_attr="gb")
+            return v2l.recurrent_group(step, x)
+
+        def via_dynamic(x):
+            return fluid.layers.dynamic_gru(
+                x, size=H, param_attr=fluid.ParamAttr(name="gw"),
+                bias_attr=fluid.ParamAttr(name="gb"))
+
+        got = run(via_group)
+        want = run(via_dynamic)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_conv3d_pool3d_wrappers(self):
+        vol = _data("vol", [1, 2, 4, 6, 6])
+        h = v2l.img_conv3d(vol, filter_size=3, num_filters=3, padding=1)
+        out = v2l.img_pool3d(h, pool_size=2, stride=2)
+        got, = _run([out], {"vol": RNG.randn(1, 2, 4, 6, 6)
+                            .astype(np.float32)})
+        assert got.shape == (1, 3, 2, 3, 3)
+
+    def test_slice_projection(self):
+        x = _data("x", [2, 8])
+        xs = RNG.randn(2, 8).astype(np.float32)
+        got, = _run([v2l.slice_projection(x, [(0, 2), (5, 8)])],
+                    {"x": xs})
+        want = np.concatenate([xs[:, 0:2], xs[:, 5:8]], axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_priorbox(self):
+        feat = _data("feat", [1, 4, 3, 3])
+        img = _data("img", [1, 3, 24, 24])
+        boxes, variances = v2l.priorbox(
+            feat, img, min_size=[8.0], max_size=[16.0],
+            aspect_ratio=[2.0], variance=[0.1, 0.1, 0.2, 0.2])
+        b, v = _run([boxes, variances],
+                    {"feat": RNG.randn(1, 4, 3, 3).astype(np.float32),
+                     "img": RNG.randn(1, 3, 24, 24).astype(np.float32)})
+        assert b.shape[-1] == 4 and b.shape == v.shape
+        # centers are normalized to the image; corners of edge priors may
+        # poke outside [0,1] (clip=False default, like the reference)
+        assert np.isfinite(b).all()
+        centers_x = (b[..., 0] + b[..., 2]) / 2
+        assert np.all(centers_x >= 0.0) and np.all(centers_x <= 1.0)
+
     def test_pooling_accepts_agg_level_default(self):
         from paddle_tpu.executor import LoDTensor
         x = fluid.layers.data(name="x", shape=[3], dtype="float32",
