@@ -267,10 +267,16 @@ _RG_STACK = []
 
 def _register_named(name, var):
     """Step layers created with name= become memory-update targets
-    (reference recurrent_group wires memory(name=N) to the step layer
-    named N)."""
-    if name is not None and _RG_STACK:
-        _RG_STACK[-1].named[name] = var
+    (reference recurrent_group / beam_search wire memory(name=N) to the
+    step layer named N)."""
+    if name is not None:
+        if _RG_STACK:
+            _RG_STACK[-1].named[name] = var
+        elif _BEAM_STACK:
+            # mirror memory()'s dispatch: layers named inside a NESTED
+            # recurrent_group belong to that group, never to the
+            # enclosing beam loop (their vars live in the rg sub-block)
+            _BEAM_STACK[-1].named[name] = var
     return var
 
 
@@ -279,9 +285,11 @@ def memory(name, size=None, boot_layer=None, **kw):
     memory layer). Only meaningful inside recurrent_group's step; boots
     from boot_layer when given, else zeros of [size]."""
     _split_kw(kw, "memory")
+    if _BEAM_STACK and not _RG_STACK:
+        return _beam_memory(name, boot_layer)
     if not _RG_STACK:
         raise ValueError("memory() must be called inside a "
-                         "recurrent_group step function")
+                         "recurrent_group or beam_search step function")
     ctx = _RG_STACK[-1]
     if boot_layer is not None:
         mem = ctx.rnn.memory(init=boot_layer)
@@ -291,6 +299,190 @@ def memory(name, size=None, boot_layer=None, **kw):
         mem = ctx.rnn.memory(shape=[size])
     ctx.memories.append((name, mem))
     return mem
+
+
+class GeneratedInput:
+    """Decode-time input marker (reference GeneratedInput,
+    trainer_config_helpers/layers.py): inside beam_search the previous
+    step's selected words feed an embedding lookup of `embedding_size`
+    over a `size`-word vocabulary; `embedding_name` shares the trained
+    embedding table."""
+
+    def __init__(self, size, embedding_name=None, embedding_size=None,
+                 embedding_param_attr=None):
+        if embedding_size is None:
+            raise ValueError("GeneratedInput needs embedding_size=")
+        self.size = size
+        self.embedding_size = embedding_size
+        attr = _as_attr(embedding_param_attr)
+        if attr is None and embedding_name is not None:
+            attr = ParamAttr(name=embedding_name)
+        self.param_attr = attr
+
+
+class _BeamCtx:
+    def __init__(self, program, parent_idx, beam_size):
+        self.program = program
+        self.parent_idx = parent_idx
+        self.beam_size = beam_size
+        self.memories = []       # (name, pre_var)
+        self.named = {}
+
+
+_BEAM_STACK = []
+
+
+def _beam_memory(name, boot_layer):
+    """memory() inside beam_search's step: the carry var and its boot
+    expansion are built in the PARENT block (before the While op is
+    appended), the step reads it per iteration, and the wrapper reorders
+    + reassigns it by beam parent after each selection."""
+    if boot_layer is None:
+        raise ValueError("beam_search memory() needs boot_layer= (the "
+                         "decoder's initial state)")
+    ctx = _BEAM_STACK[-1]
+    prog = ctx.program
+    cur = prog.current_block_idx
+    prog.current_block_idx = ctx.parent_idx
+    try:
+        lanes = fluid_layers.expand(
+            fluid_layers.unsqueeze(boot_layer, axes=[1]),
+            expand_times=[1, ctx.beam_size, 1])      # [B, K, D]
+        pre = fluid_layers.assign(lanes)
+    finally:
+        prog.current_block_idx = cur
+    ctx.memories.append((name, pre))
+    return pre
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
+                name=None):
+    """Beam-search generation (reference v2 beam_search over
+    RecurrentGradientMachine's generation mode,
+    RecurrentGradientMachine.h:73-150; here lowered onto the fluid beam
+    ops — beam_search_op.cc / beam_search_decode_op.cc — over dense
+    [B, K] beam lanes, the same convention the book decoder and the C
+    API's beam program use).
+
+    `input`: one GeneratedInput (the word feedback loop) plus any
+    StaticInputs/plain vars passed through to `step` unchanged (step
+    sees lane-shaped tensors: the generated embedding is [B, K, emb]).
+    `step(gen_emb, *statics)` returns the per-lane word PROBABILITIES
+    [B, K, vocab]; inside it, memory(name=N, boot_layer=init) carries
+    decoder state across steps — create its update with name=N, and the
+    wrapper reorders it by each step's surviving parent lanes. Returns
+    (sentences, scores) from beam_search_decode."""
+    from ..framework.framework import default_main_program
+
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    gens = [x for x in inputs if isinstance(x, GeneratedInput)]
+    statics = [x.input if isinstance(x, StaticInput) else x
+               for x in inputs if not isinstance(x, GeneratedInput)]
+    if len(gens) != 1:
+        raise ValueError("beam_search needs exactly one GeneratedInput")
+    if not statics:
+        raise ValueError("beam_search needs at least one non-generated "
+                         "input as the batch anchor (the reference "
+                         "passes the encoded source as StaticInput)")
+    gen = gens[0]
+    anchor = statics[0]
+    k = beam_size
+
+    import numpy as _np
+    counter = fluid_layers.fill_constant(shape=[1], dtype="int64", value=0)
+    max_len = fluid_layers.fill_constant(shape=[1], dtype="int64",
+                                         value=max_length)
+    init_ids = fluid_layers.fill_constant_batch_size_like(
+        input=anchor, shape=[-1, k], dtype="int64", value=bos_id)
+    lane_penalty = fluid_layers.assign(
+        _np.concatenate([[0.0], _np.full(k - 1, -1e9)])
+        .astype(_np.float32))
+    init_scores = fluid_layers.elementwise_add(
+        fluid_layers.fill_constant_batch_size_like(
+            input=anchor, shape=[-1, k], dtype="float32", value=0.0),
+        lane_penalty, axis=1)
+
+    cap = max_length + 1
+    ids_arr = fluid_layers.array_write(init_ids, counter, capacity=cap)
+    parents_arr = fluid_layers.array_write(
+        fluid_layers.cast(init_ids, "int32"), counter, capacity=cap)
+    scores_arr = fluid_layers.array_write(init_scores, counter,
+                                          capacity=cap)
+    pre_ids = fluid_layers.assign(init_ids)
+    pre_scores = fluid_layers.assign(init_scores)
+
+    prog = default_main_program()
+    ctx = _BeamCtx(prog, prog.current_block_idx, k)
+    cond = fluid_layers.less_than(x=counter, y=max_len)
+    w = fluid_layers.While(cond=cond, max_iters=max_length + 1)
+    with w.block():
+        _BEAM_STACK.append(ctx)
+        try:
+            tok_emb = fluid_layers.reshape(
+                fluid_layers.embedding(
+                    input=pre_ids, size=[gen.size, gen.embedding_size],
+                    param_attr=gen.param_attr),
+                [-1, k, gen.embedding_size])         # [B, K, emb] — the
+            # reshape pins the lane axis: embedding squeezes trailing
+            # singleton id dims, which would collapse K=1 lanes
+            probs = step(tok_emb, *statics)
+        finally:
+            _BEAM_STACK.pop()
+        logp = fluid_layers.log(
+            fluid_layers.clip(probs, min=1e-12, max=1.0))
+        sel_ids, sel_scores, parent = fluid_layers.beam_search(
+            pre_ids=pre_ids, pre_scores=pre_scores, scores=logp,
+            beam_size=k, end_id=eos_id)
+        fluid_layers.increment(counter, value=1, in_place=True)
+        fluid_layers.array_write(sel_ids, counter, array=ids_arr)
+        fluid_layers.array_write(parent, counter, array=parents_arr)
+        fluid_layers.array_write(sel_scores, counter, array=scores_arr)
+        fluid_layers.assign(sel_ids, pre_ids)
+        fluid_layers.assign(sel_scores, pre_scores)
+        if ctx.memories:
+            # surviving lanes carry their PARENT's state: gather lanes
+            # with a one-hot matmul (dense-lane equivalent of the
+            # reference's memory frame reorder)
+            onehot = fluid_layers.reshape(
+                fluid_layers.cast(
+                    fluid_layers.one_hot(
+                        fluid_layers.cast(parent, "int64"), k),
+                    "float32"),
+                [-1, k, k])   # pin [B,K,K]: one_hot squeezes K=1 lanes
+            for name_m, pre in ctx.memories:
+                tgt = ctx.named.get(name_m)
+                if tgt is None:
+                    raise ValueError(
+                        f"beam_search: memory('{name_m}') has no step "
+                        f"layer named '{name_m}' to carry — create its "
+                        "update with name=")
+                fluid_layers.assign(fluid_layers.matmul(onehot, tgt),
+                                    pre)
+        # stop early once EVERY lane has emitted eos (the reference
+        # generation mode stops when all sequences finish): cond =
+        # (counter < max_len) AND any(sel_ids != eos). Composed from
+        # arithmetic ops — |ids - eos| sums to 0 only when all-finished.
+        not_done = fluid_layers.less_than(
+            x=fluid_layers.fill_constant(shape=[1], dtype="float32",
+                                         value=0.5),
+            y=fluid_layers.reduce_sum(
+                fluid_layers.abs(fluid_layers.cast(
+                    fluid_layers.elementwise_sub(
+                        sel_ids,
+                        fluid_layers.fill_constant(
+                            shape=[1], dtype="int64", value=eos_id)),
+                    "float32")), keep_dim=True))
+        in_budget = fluid_layers.less_than(x=counter, y=max_len)
+        fluid_layers.assign(
+            fluid_layers.cast(
+                fluid_layers.elementwise_mul(
+                    fluid_layers.cast(in_budget, "float32"),
+                    fluid_layers.cast(not_done, "float32")), "bool"),
+            cond)
+
+    sentences, final_scores = fluid_layers.beam_search_decode(
+        ids_arr, parents_arr, scores=scores_arr, end_id=eos_id)
+    return sentences, final_scores
 
 
 class StaticInput:
