@@ -563,6 +563,21 @@ _main_program = Program()
 _startup_program = Program()
 
 
+@contextlib.contextmanager
+def in_block(program: Program, block_idx: int):
+    """Temporarily build ops into `block_idx` of `program` — the shared
+    idiom for control-flow builders that must emit setup ops into the
+    PARENT block while the sub-block is current (DynamicRNN memory init,
+    v2 beam_search boot state: those ops run before the loop op, which
+    the enclosing context appends only on exit)."""
+    cur = program.current_block_idx
+    program.current_block_idx = block_idx
+    try:
+        yield program.current_block()
+    finally:
+        program.current_block_idx = cur
+
+
 def default_main_program() -> Program:
     return _main_program
 

@@ -423,15 +423,11 @@ class _RNNBase:
             assert shape is not None
             ref = batch_ref if batch_ref is not None else self.seq_inputs[0]
             # build the init in the PARENT block (it runs before the loop)
-            program = self.helper.main_program
-            cur = program.current_block_idx
-            program.current_block_idx = self.parent_block.idx
-            try:
+            from ..framework.framework import in_block
+            with in_block(self.helper.main_program, self.parent_block.idx):
                 init = tensor_layers.fill_constant_batch_size_like(
                     input=ref, shape=[-1] + list(shape), dtype=dtype,
                     value=value)
-            finally:
-                program.current_block_idx = cur
         mem = self.sub_block.create_var(name=None, dtype=init.dtype,
                                         shape=init.shape)
         self.init_states.append(init)

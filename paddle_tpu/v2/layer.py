@@ -312,11 +312,20 @@ class GeneratedInput:
                  embedding_param_attr=None):
         if embedding_size is None:
             raise ValueError("GeneratedInput needs embedding_size=")
+        attr = _as_attr(embedding_param_attr)
+        if attr is None:
+            if embedding_name is None:
+                # the reference makes embedding_name a required arg
+                # (layers.py GeneratedInput) so decode always shares the
+                # TRAINED table — an auto-named fresh parameter would
+                # generate through random weights with no error
+                raise ValueError(
+                    "GeneratedInput needs embedding_name= (the trained "
+                    "embedding table to decode with) or an explicit "
+                    "embedding_param_attr")
+            attr = ParamAttr(name=embedding_name)
         self.size = size
         self.embedding_size = embedding_size
-        attr = _as_attr(embedding_param_attr)
-        if attr is None and embedding_name is not None:
-            attr = ParamAttr(name=embedding_name)
         self.param_attr = attr
 
 
@@ -337,26 +346,23 @@ def _beam_memory(name, boot_layer):
     expansion are built in the PARENT block (before the While op is
     appended), the step reads it per iteration, and the wrapper reorders
     + reassigns it by beam parent after each selection."""
+    from ..framework.framework import in_block
+
     if boot_layer is None:
         raise ValueError("beam_search memory() needs boot_layer= (the "
                          "decoder's initial state)")
     ctx = _BEAM_STACK[-1]
-    prog = ctx.program
-    cur = prog.current_block_idx
-    prog.current_block_idx = ctx.parent_idx
-    try:
+    with in_block(ctx.program, ctx.parent_idx):
         lanes = fluid_layers.expand(
             fluid_layers.unsqueeze(boot_layer, axes=[1]),
             expand_times=[1, ctx.beam_size, 1])      # [B, K, D]
         pre = fluid_layers.assign(lanes)
-    finally:
-        prog.current_block_idx = cur
     ctx.memories.append((name, pre))
     return pre
 
 
-def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
-                name=None):
+def beam_search(step, input, bos_id, eos_id, beam_size,
+                num_results_per_sample=None, max_length=500, name=None):
     """Beam-search generation (reference v2 beam_search over
     RecurrentGradientMachine's generation mode,
     RecurrentGradientMachine.h:73-150; here lowered onto the fluid beam
@@ -365,28 +371,42 @@ def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
     API's beam program use).
 
     `input`: one GeneratedInput (the word feedback loop) plus any
-    StaticInputs/plain vars passed through to `step` unchanged (step
-    sees lane-shaped tensors: the generated embedding is [B, K, emb]).
-    `step(gen_emb, *statics)` returns the per-lane word PROBABILITIES
+    StaticInputs/plain vars passed through to `step` unchanged, IN THE
+    LIST'S ORDER — the generated embedding [B, K, emb] is substituted at
+    the GeneratedInput's position, exactly like the reference's
+    __real_step__ insertion, so a reference-ordered step signature works
+    unmodified. `step(...)` returns the per-lane word PROBABILITIES
     [B, K, vocab]; inside it, memory(name=N, boot_layer=init) carries
     decoder state across steps — create its update with name=N, and the
-    wrapper reorders it by each step's surviving parent lanes. Returns
-    (sentences, scores) from beam_search_decode."""
+    wrapper reorders it by each step's surviving parent lanes.
+    Returns (sentences, scores) from beam_search_decode, lanes sliced to
+    num_results_per_sample (default beam_size)."""
     from ..framework.framework import default_main_program
 
     inputs = input if isinstance(input, (list, tuple)) else [input]
-    gens = [x for x in inputs if isinstance(x, GeneratedInput)]
+    gen_pos = [i for i, x in enumerate(inputs)
+               if isinstance(x, GeneratedInput)]
     statics = [x.input if isinstance(x, StaticInput) else x
                for x in inputs if not isinstance(x, GeneratedInput)]
-    if len(gens) != 1:
+    if len(gen_pos) != 1:
         raise ValueError("beam_search needs exactly one GeneratedInput")
     if not statics:
         raise ValueError("beam_search needs at least one non-generated "
                          "input as the batch anchor (the reference "
                          "passes the encoded source as StaticInput)")
-    gen = gens[0]
+    gen = inputs[gen_pos[0]]
     anchor = statics[0]
+    if getattr(anchor, "lod_level", 0):
+        raise ValueError(
+            "beam_search: the first non-generated input is the BATCH "
+            "anchor and must be one row per sample, but it is a "
+            "SEQUENCE (lod_level>0) — its token count would silently "
+            "become the beam batch. Pool it (sequence_last_step/pooling)"
+            " first, like the reference's decoder boot state")
     k = beam_size
+    n_results = num_results_per_sample or k
+    if n_results > k:
+        raise ValueError("num_results_per_sample cannot exceed beam_size")
 
     import numpy as _np
     counter = fluid_layers.fill_constant(shape=[1], dtype="int64", value=0)
@@ -425,7 +445,11 @@ def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
                 [-1, k, gen.embedding_size])         # [B, K, emb] — the
             # reshape pins the lane axis: embedding squeezes trailing
             # singleton id dims, which would collapse K=1 lanes
-            probs = step(tok_emb, *statics)
+            step_args = [tok_emb if isinstance(x, GeneratedInput)
+                         else (x.input if isinstance(x, StaticInput)
+                               else x)
+                         for x in inputs]            # reference order
+            probs = step(*step_args)
         finally:
             _BEAM_STACK.pop()
         logp = fluid_layers.log(
@@ -482,6 +506,11 @@ def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
 
     sentences, final_scores = fluid_layers.beam_search_decode(
         ids_arr, parents_arr, scores=scores_arr, end_id=eos_id)
+    if n_results < k:
+        sentences = fluid_layers.split(
+            sentences, [n_results, k - n_results], dim=1)[0]
+        final_scores = fluid_layers.split(
+            final_scores, [n_results, k - n_results], dim=1)[0]
     return sentences, final_scores
 
 
@@ -938,12 +967,22 @@ def gru_step(input, output_mem, size=None, act=None, gate_act=None,
     memory())."""
     ignored = _split_kw(kw, "gru_step", init_ok=True)
     size = size or output_mem.shape[-1]
+    x, mem = input, output_mem
+    lanes = None
+    if len(x.shape) == 3:
+        # beam_search lanes [B, K, *]: gru_unit computes on 2-D rows, so
+        # flatten the lane axis through the step and restore it after
+        lanes = mem.shape[1]
+        x = fluid_layers.reshape(x, [-1, x.shape[-1]])
+        mem = fluid_layers.reshape(mem, [-1, size])
     h, _reset, _gate = fluid_layers.gru_unit(
-        input, output_mem, size * 3,
+        x, mem, size * 3,
         param_attr=_attr_with_init(param_attr, ignored),
         bias_attr=_as_attr(bias_attr),
         activation=_act_name(act) or "tanh",
         gate_activation=_act_name(gate_act) or "sigmoid")
+    if lanes is not None:
+        h = fluid_layers.reshape(h, [-1, lanes, size])
     return _register_named(name, h)
 
 

@@ -83,6 +83,51 @@ def test_beam1_matches_greedy_oracle():
         assert got == want, (b, got, want)
 
 
+def test_reference_input_order_and_num_results():
+    """Reference-ordered input=[StaticInput, GeneratedInput] must call
+    step(static, gen_emb) — positional substitution like the reference's
+    __real_step__ — and num_results_per_sample slices the lanes."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        enc = fluid.layers.data(name="enc", shape=[H], dtype="float32")
+
+        def step(enc_static, gen_emb):       # static FIRST, like the ref
+            assert enc_static.shape[-1] == H
+            assert gen_emb.shape[-1] == E
+            prev = v2l.memory("h", boot_layer=enc_static)
+            dec_in = fluid.layers.concat([gen_emb, prev], axis=-1)
+            h = v2l.fc(dec_in, size=H, act="tanh", num_flatten_dims=2,
+                       name="h", param_attr="dw", bias_attr="db")
+            return fluid.layers.softmax(
+                v2l.fc(h, size=V, num_flatten_dims=2, param_attr="ow",
+                       bias_attr="ob"))
+
+        sentences, scores = v2l.beam_search(
+            step,
+            input=[v2l.StaticInput(enc),
+                   v2l.GeneratedInput(size=V, embedding_name="gen_emb_w",
+                                      embedding_size=E)],
+            bos_id=BOS, eos_id=EOS, beam_size=4,
+            num_results_per_sample=2, max_length=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with executor_mod.scope_guard(executor_mod.Scope()):
+        exe.run(startup)
+        out_ids, out_scores = exe.run(
+            main,
+            feed={"enc": np.random.RandomState(9).randn(3, H)
+                  .astype(np.float32)},
+            fetch_list=[sentences, scores])
+    out_ids = np.asarray(out_ids)
+    assert out_ids.shape[:2] == (3, 2)       # lanes sliced to 2 of 4
+    assert (out_ids[:, :, 0] == BOS).all()
+
+
+def test_generated_input_requires_embedding_name():
+    import pytest
+    with pytest.raises(ValueError, match="embedding_name"):
+        v2l.GeneratedInput(size=V, embedding_size=E)
+
+
 def test_all_lanes_eos_stops_cleanly():
     """With the output head rigged so eos dominates, generation must
     stop after one emission (all lanes finished -> cond false) and the
