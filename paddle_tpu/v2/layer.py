@@ -361,8 +361,8 @@ def _beam_memory(name, boot_layer):
     return pre
 
 
-def beam_search(step, input, bos_id, eos_id, beam_size,
-                num_results_per_sample=None, max_length=500, name=None):
+def beam_search(step, input, bos_id, eos_id, beam_size, max_length=500,
+                name=None, num_results_per_sample=None):
     """Beam-search generation (reference v2 beam_search over
     RecurrentGradientMachine's generation mode,
     RecurrentGradientMachine.h:73-150; here lowered onto the fluid beam
@@ -384,17 +384,22 @@ def beam_search(step, input, bos_id, eos_id, beam_size,
     from ..framework.framework import default_main_program
 
     inputs = input if isinstance(input, (list, tuple)) else [input]
-    gen_pos = [i for i, x in enumerate(inputs)
+    # resolve markers ONCE, preserving positions: GeneratedInput slots
+    # stay as markers (substituted with the embedding each iteration),
+    # StaticInputs unwrap to their variables
+    resolved = [x if isinstance(x, GeneratedInput)
+                else (x.input if isinstance(x, StaticInput) else x)
+                for x in inputs]
+    gen_pos = [i for i, x in enumerate(resolved)
                if isinstance(x, GeneratedInput)]
-    statics = [x.input if isinstance(x, StaticInput) else x
-               for x in inputs if not isinstance(x, GeneratedInput)]
+    statics = [x for x in resolved if not isinstance(x, GeneratedInput)]
     if len(gen_pos) != 1:
         raise ValueError("beam_search needs exactly one GeneratedInput")
     if not statics:
         raise ValueError("beam_search needs at least one non-generated "
                          "input as the batch anchor (the reference "
                          "passes the encoded source as StaticInput)")
-    gen = inputs[gen_pos[0]]
+    gen = resolved[gen_pos[0]]
     anchor = statics[0]
     if getattr(anchor, "lod_level", 0):
         raise ValueError(
@@ -404,9 +409,12 @@ def beam_search(step, input, bos_id, eos_id, beam_size,
             "become the beam batch. Pool it (sequence_last_step/pooling)"
             " first, like the reference's decoder boot state")
     k = beam_size
-    n_results = num_results_per_sample or k
-    if n_results > k:
-        raise ValueError("num_results_per_sample cannot exceed beam_size")
+    n_results = k if num_results_per_sample is None \
+        else num_results_per_sample
+    if not 1 <= n_results <= k:
+        raise ValueError(
+            f"num_results_per_sample must be in [1, beam_size={k}], got "
+            f"{n_results}")
 
     import numpy as _np
     counter = fluid_layers.fill_constant(shape=[1], dtype="int64", value=0)
@@ -445,10 +453,8 @@ def beam_search(step, input, bos_id, eos_id, beam_size,
                 [-1, k, gen.embedding_size])         # [B, K, emb] — the
             # reshape pins the lane axis: embedding squeezes trailing
             # singleton id dims, which would collapse K=1 lanes
-            step_args = [tok_emb if isinstance(x, GeneratedInput)
-                         else (x.input if isinstance(x, StaticInput)
-                               else x)
-                         for x in inputs]            # reference order
+            step_args = list(resolved)
+            step_args[gen_pos[0]] = tok_emb          # reference order
             probs = step(*step_args)
         finally:
             _BEAM_STACK.pop()
@@ -972,6 +978,11 @@ def gru_step(input, output_mem, size=None, act=None, gate_act=None,
     if len(x.shape) == 3:
         # beam_search lanes [B, K, *]: gru_unit computes on 2-D rows, so
         # flatten the lane axis through the step and restore it after
+        if len(mem.shape) != 3:
+            raise ValueError(
+                "gru_step: 3-D (lane-shaped) input needs a 3-D "
+                f"output_mem, got {tuple(mem.shape)} — expand the "
+                "memory over the lanes (beam memory() does this)")
         lanes = mem.shape[1]
         x = fluid_layers.reshape(x, [-1, x.shape[-1]])
         mem = fluid_layers.reshape(mem, [-1, size])

@@ -16,7 +16,13 @@ def test_image_classification(net):
     model_fn = models.resnet_cifar10 if net == "resnet" else models.vgg16
     avg_cost, predict, acc = models.build_image_classifier(
         model_fn, img, label, class_dim=10)
-    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    # vgg16 has no batch norm: at 1e-3 its short run sits on the edge of
+    # divergence, where float-reassociation differences between COMPILES
+    # (fresh vs persistent-cache executables) flipped the outcome — the
+    # round-4 "watch item" flake, finally captured. 2e-4 is stable for
+    # every compile while still dropping the loss within the budget.
+    lr = 1e-3 if net == "resnet" else 2e-4
+    fluid.optimizer.Adam(learning_rate=lr).minimize(avg_cost)
 
     # vgg16 costs ~6x the residual net per step on the 1-core CI box; it
     # gets a smaller batch + shorter run with a relative-improvement
