@@ -50,6 +50,7 @@ from . import flags
 from . import concurrency
 from .concurrency import (make_channel, channel_send, channel_recv,
                           channel_close, Go, Select)
+from . import telemetry
 from .parallel import transpiler
 from .parallel.transpiler import DistributeTranspiler
 

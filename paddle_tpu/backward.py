@@ -75,7 +75,7 @@ _ZERO_GRAD_SAFE = frozenset({
     "max_sequence_len", "lod_array_length", "less_than", "less_equal",
     "greater_than", "greater_equal", "equal", "not_equal", "logical_and",
     "logical_or", "logical_not", "logical_xor", "is_empty",
-    "print", "one_hot", "uniform_random", "gaussian_random",
+    "one_hot", "uniform_random", "gaussian_random",
     "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
     "sign", "arg_max", "arg_min", "crf_decoding", "ctc_align",
     "sequence_mask", "prior_box",

@@ -7,6 +7,8 @@ Usage:
                                [--checkpoint-dir C] [--resume]
     python -m paddle_tpu time  --config=conf.py [--steps N]
     python -m paddle_tpu infer --model-dir=D --input=batch.npz
+    python -m paddle_tpu telemetry [--log step.jsonl [--tail N]]
+                                   [--prometheus] [--reduce]
     python -m paddle_tpu version
 
 The config file is a Python module (the reference's --config was a Python
@@ -233,6 +235,72 @@ def cmd_infer(args):
     return 0
 
 
+def cmd_telemetry(args):
+    """Pretty-print a telemetry snapshot or tail/summarize a JSONL step log
+    (the scrape-less half of the ISSUE's observability story: the same data
+    prometheus_text() exports, readable from a shell)."""
+    import json
+
+    from paddle_tpu import telemetry
+
+    if args.log:
+        recs = telemetry.read_step_log(args.log)
+        if args.tail:
+            for r in recs[-args.tail:]:
+                print(json.dumps(r, sort_keys=True))
+            return 0
+        by_kind = {}
+        for r in recs:
+            by_kind.setdefault(r.get("kind", "?"), []).append(r)
+        print(f"{args.log}: {len(recs)} events")
+        for kind in sorted(by_kind):
+            rs = by_kind[kind]
+            secs = [r["seconds"] for r in rs if "seconds" in r]
+            line = f"  {kind:12s} {len(rs):6d}"
+            if secs:
+                line += (f"  total {sum(secs):.3f}s"
+                         f"  mean {sum(secs) / len(secs) * 1e3:.2f}ms"
+                         f"  max {max(secs) * 1e3:.2f}ms")
+            print(line)
+        misses = by_kind.get("cache_miss", [])
+        if misses:
+            sig = misses[-1].get("signature")
+            print(f"  last retrace signature: {sig}")
+        return 0
+
+    snap = telemetry.snapshot(reduce=args.reduce)
+    if args.prometheus:
+        print(telemetry.prometheus_text(snap), end="")
+        return 0
+    scope = "fleet" if args.reduce else f"host {snap.get('host', 0)}"
+    print(f"telemetry snapshot ({scope})")
+    for kind in ("counters", "gauges"):
+        series = snap.get(kind, {})
+        if not series:
+            continue
+        print(f"{kind}:")
+        for name in sorted(series):
+            for lk in sorted(series[name]):
+                label = f"{{{lk}}}" if lk else ""
+                print(f"  {name}{label} = {_fmt_num(series[name][lk])}")
+    hists = snap.get("histograms", {})
+    if hists:
+        print("histograms:")
+        for name in sorted(hists):
+            for lk in sorted(hists[name]):
+                h = hists[name][lk]
+                label = f"{{{lk}}}" if lk else ""
+                n = h["count"]
+                mean = h["sum"] / n if n else 0.0
+                print(f"  {name}{label}: count={n:g} sum={h['sum']:.4f}s "
+                      f"mean={mean * 1e3:.3f}ms")
+    return 0
+
+
+def _fmt_num(v: float) -> str:
+    return f"{int(v)}" if float(v).is_integer() else f"{v:.6g}"
+
+
 def cmd_version(_args):
     import paddle_tpu
     import jax
@@ -278,6 +346,19 @@ def main(argv=None):
     p_infer.add_argument("--input", required=True,
                          help=".npz with one array per feed name")
     p_infer.set_defaults(fn=cmd_infer)
+
+    p_tel = sub.add_parser(
+        "telemetry", help="print a metrics snapshot or tail a step log")
+    p_tel.add_argument("--log", default=None,
+                       help="JSONL step log to summarize (see "
+                            "telemetry.enable_step_log / PADDLE_TPU_STEP_LOG)")
+    p_tel.add_argument("--tail", type=int, default=0,
+                       help="with --log: print the last N raw events")
+    p_tel.add_argument("--prometheus", action="store_true",
+                       help="emit Prometheus text exposition format")
+    p_tel.add_argument("--reduce", action="store_true",
+                       help="allreduce the snapshot across hosts first")
+    p_tel.set_defaults(fn=cmd_telemetry)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

@@ -89,6 +89,15 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
             clip_value = tensor.fill_constant([1], "float32", self.clip_norm)
             context[scale_key] = nn.elementwise_div(
                 clip_value, nn.elementwise_max(clip_value, global_norm))
+            # mark the norm var for the executor's telemetry side-fetch:
+            # Executor.run publishes it as the optimizer_global_norm gauge
+            # (ISSUE: "global-norm gauge when clipping is active"); the
+            # mark rides the program so clones/pruned programs drop it
+            prog = global_norm.block.program
+            marks = getattr(prog, "_telemetry_fetch_extra", None)
+            if marks is None:
+                marks = prog._telemetry_fetch_extra = {}
+            marks["optimizer_global_norm"] = global_norm.name
         return param, nn.elementwise_mul(grad, context[scale_key])
 
 

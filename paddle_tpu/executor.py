@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import telemetry
 from .framework.desc import VarType
 from .framework.framework import Program, Variable, default_main_program
 from .ops import registry
@@ -274,6 +275,11 @@ _EAGER = os.environ.get("PADDLE_TPU_EAGER", "0") == "1"
 _CHECK_NAN_INF = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
 _BENCHMARK = os.environ.get("PADDLE_TPU_BENCHMARK", "0") == "1"
 _VLOG_LEVEL = int(os.environ.get("PADDLE_TPU_VLOG", "0") or 0)
+# telemetry side-fetches (program._telemetry_fetch_extra, e.g. the clip
+# pass's global-norm var): each one forces a device->host read per step to
+# feed its gauge — PADDLE_TPU_TELEMETRY_FETCH=0 turns them off for
+# latency-critical pipelined loops
+_TELEMETRY_FETCH = os.environ.get("PADDLE_TPU_TELEMETRY_FETCH", "1") == "1"
 
 
 def vlog(level: int, msg: str):
@@ -447,6 +453,12 @@ class _CompiledBlock:
         # strong ref: the cache key uses id(program), which stays valid only
         # while the program object is alive
         self.program = program
+        # feed (name, shape, dtype) signatures already traced by self.fn:
+        # the executor's view of jax.jit's retrace cache, kept so telemetry
+        # can name the signature that caused a cache miss (a retrace the
+        # executor-level cache key — names only, no shapes — cannot see)
+        self.seen_sigs: set = set()
+        self.last_sig = None
 
 
 class Executor:
@@ -486,6 +498,20 @@ class Executor:
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in fetch_list]
         jit_mode = (not _EAGER) if use_jit is None else use_jit
+
+        prog_label = telemetry.program_label(program)
+        place_label = f"{type(self.place).__name__}:{self.place.device_id}"
+        # telemetry side-fetches (gauge name -> var name), e.g. the global
+        # norm the clip pass marked: fetched alongside the user's list (so
+        # they share the compiled block) and popped before values return
+        n_user_fetch = len(fetch_names)
+        extra_fetch = []
+        if _TELEMETRY_FETCH:
+            marked = getattr(program, "_telemetry_fetch_extra", None)
+            if marked:
+                extra_fetch = [(m, n) for m, n in sorted(marked.items())
+                               if n not in fetch_names]
+                fetch_names = fetch_names + [n for _, n in extra_fetch]
 
         # Normalize feeds. LoDTensor feeds with a LoD become padded dense
         # arrays plus a `<name>@SEQLEN` lengths input (pack_to_padded) — the
@@ -558,6 +584,10 @@ class Executor:
                     id(compiled.fn),
                     _hlo_supplier(compiled.fn, feed_vals, state_vals,
                                   np.uint32(rng_counter)))
+            sig = telemetry.signature_of(feed_vals)
+            new_sig = sig not in compiled.seen_sigs
+            compile_before = telemetry.jax_compile_seconds()
+            run_t0 = time.perf_counter()
             with jax.default_device(self.device):
                 with profiler_mod.record("executor_run(jit)"):
                     fetch_vals, fetch_lens, new_state = compiled.fn(
@@ -567,6 +597,48 @@ class Executor:
                         # inside the timed scope so the event measures the
                         # step, not the enqueue (only when profiling)
                         jax.block_until_ready((fetch_vals, new_state))
+            run_dt = time.perf_counter() - run_t0
+            # compile-vs-execute split: XLA's own backend_compile events
+            # (jax.monitoring) accumulated across the call — catches the
+            # jit retraces the executor cache key cannot see
+            compile_s = telemetry.jax_compile_seconds() - compile_before
+            mode, donated = "jit", len(state_vals)
+            cache_status = "miss" if new_sig else "hit"
+            if new_sig:
+                cause = ("first_compile" if not compiled.seen_sigs
+                         else "signature_change")
+                compiled.seen_sigs.add(sig)
+                telemetry.counter(
+                    "executor_compiles_total", "block traces/compiles",
+                    labels=("program", "place")).labels(
+                        program=prog_label, place=place_label).inc()
+                telemetry.counter(
+                    "executor_compile_seconds_total",
+                    "XLA compile wall seconds spent inside Executor.run",
+                    labels=("program", "place")).labels(
+                        program=prog_label, place=place_label).inc(compile_s)
+                telemetry.log_event(
+                    "compile", program=prog_label, place=place_label,
+                    cause=cause, seconds=compile_s,
+                    signature=[list(s) for s in sig])
+                if cause == "signature_change":
+                    last = compiled.last_sig or ()
+                    telemetry.counter(
+                        "executor_cache_misses_total",
+                        "jit retraces caused by a changed feed signature",
+                        labels=("program", "place")).labels(
+                            program=prog_label, place=place_label).inc()
+                    telemetry.log_event(
+                        "cache_miss", program=prog_label, place=place_label,
+                        signature=[list(s) for s in sig],
+                        changed=[list(s) for s in sig if s not in last])
+            else:
+                telemetry.counter(
+                    "executor_cache_hits_total",
+                    "runs served by an already-traced signature",
+                    labels=("program", "place")).labels(
+                        program=prog_label, place=place_label).inc()
+            compiled.last_sig = sig
             if _CHECK_NAN_INF:
                 # jit-path equivalent of the reference FLAGS_check_nan_inf
                 # per-op scan (executor.cc:325-333): inside one fused XLA
@@ -581,9 +653,34 @@ class Executor:
         else:
             seed = program.random_seed or 12345
             rng_key = jax.random.fold_in(jax.random.key(seed), rng_counter)
+            compile_before = telemetry.jax_compile_seconds()
+            run_t0 = time.perf_counter()
             fetch_vals, fetch_lens, new_state = self._run_eager(
                 program, feed_vals, state_vals, fetch_names, persist_out,
                 rng_key, lod_map)
+            run_dt = time.perf_counter() - run_t0
+            compile_s = telemetry.jax_compile_seconds() - compile_before
+            mode, donated, cache_status = "eager", 0, "n/a"
+
+        telemetry.counter(
+            "executor_runs_total", "Executor.run calls",
+            labels=("program", "place", "mode")).labels(
+                program=prog_label, place=place_label, mode=mode).inc()
+        telemetry.histogram(
+            "executor_run_seconds",
+            "Executor.run wall seconds (dispatch-only unless profiling "
+            "forces device sync)", labels=("program", "mode")).labels(
+                program=prog_label, mode=mode).observe(run_dt)
+        if self._analysis(program)[3]:
+            telemetry.counter(
+                "optimizer_steps_total",
+                "runs of programs carrying optimizer-role ops",
+                labels=("program",)).labels(program=prog_label).inc()
+        telemetry.log_event(
+            "run", program=prog_label, place=place_label, mode=mode,
+            seconds=run_dt, compile_s=compile_s,
+            execute_s=max(run_dt - compile_s, 0.0), cache=cache_status,
+            donated=donated, feeds=len(feed_vals), fetches=n_user_fetch)
 
         for n, v in new_state.items():
             if n.endswith(SEQLEN_SUFFIX) or n.endswith(SEQLEN2_SUFFIX):
@@ -599,6 +696,20 @@ class Executor:
                 scope.set_var(n, LoDTensor(packed, lod))
             else:
                 scope.set_var(n, v)
+        if extra_fetch:
+            # pop the telemetry side-fetches (gauges, not user outputs);
+            # float() forces the device read — the documented cost of
+            # _telemetry_fetch_extra (PADDLE_TPU_TELEMETRY_FETCH=0 disables)
+            for (metric, _n), val in zip(extra_fetch,
+                                         fetch_vals[n_user_fetch:]):
+                try:
+                    telemetry.gauge(metric, labels=("program",)).labels(
+                        program=prog_label).set(
+                            float(np.asarray(val).ravel()[0]))
+                except (TypeError, ValueError, IndexError):
+                    pass
+            fetch_vals = fetch_vals[:n_user_fetch]
+            fetch_names = fetch_names[:n_user_fetch]
         # Fetched sequence vars come back in the reference's packed layout
         # ([sum_len, ...] rows): numpy mode returns the packed array, LoDTensor
         # mode additionally carries the offsets.
@@ -660,14 +771,16 @@ class Executor:
                 writes.add(name)
 
     def _analysis(self, program):
-        """Per-(program, version) cached read/write sets + persistable map.
-        The full block walk costs milliseconds on a ResNet-scale program
-        and used to run twice per Executor.run — at TPU step rates that
-        was a measurable host-side stall between steps."""
+        """Per-(program, version) cached read/write sets + persistable map +
+        whether the block carries optimizer-role ops (telemetry's run-time
+        train-step counter). The full block walk costs milliseconds on a
+        ResNet-scale program and used to run twice per Executor.run — at
+        TPU step rates that was a measurable host-side stall between
+        steps."""
         key = (id(program), getattr(program, "_version", 0))
         hit = self._analysis_cache.get(key)
         if hit is not None and hit[0] is program:
-            return hit[1], hit[2], hit[3]
+            return hit[1], hit[2], hit[3], hit[4]
         reads, writes = set(), set()
         self._block_reads_writes(program, program.global_block(),
                                  reads, writes, set())
@@ -676,9 +789,13 @@ class Executor:
             for name, v in b.desc.vars.items():
                 if v.persistable:
                     persistable[name] = True
+        has_optimize = any(
+            op.desc.attrs.get("op_role") == "optimize"
+            for op in program.global_block().ops)
         # keep a strong program ref: the cache key uses id(program)
-        self._analysis_cache[key] = (program, reads, writes, persistable)
-        return reads, writes, persistable
+        self._analysis_cache[key] = (program, reads, writes, persistable,
+                                     has_optimize)
+        return reads, writes, persistable, has_optimize
 
     def _external_inputs(self, program, fed: set, scope) -> List[str]:
         """Vars the block reads from the scope: already-present scope vars or
@@ -687,7 +804,7 @@ class Executor:
         (Computing reads with an empty produced-set and subtracting `fed`
         is equivalent to seeding produced with `fed`: a fed var read before
         production lands in reads and is then subtracted.)"""
-        reads, _writes, persistable = self._analysis(program)
+        reads, _writes, persistable, _ = self._analysis(program)
         out = []
         for n in sorted(reads - fed):
             if scope.has_var(n) and scope.find_var(n) is not None:
@@ -697,7 +814,7 @@ class Executor:
         return out
 
     def _persistable_outputs(self, program) -> List[str]:
-        _reads, writes, persistable = self._analysis(program)
+        _reads, writes, persistable, _ = self._analysis(program)
         return [n for n in sorted(writes) if persistable.get(n)]
 
     # --- execution ----------------------------------------------------------

@@ -95,7 +95,23 @@ def _l1_norm(ctx, op_, ins):
     return {"Out": [jnp.sum(jnp.abs(jnp.asarray(ins["X"][0]))).reshape(1)]}
 
 
-@op("print", grad=NO_GRAD)
+def _print_grad_maker(fwd, no_grad_set):
+    """Identity pass-through grad: print only observes, so In@GRAD is
+    Out@GRAD verbatim (reference print_op.cc registers its grad the same
+    way; before this maker a Print on the loss path silently zeroed the
+    gradients flowing through it — ADVICE r5)."""
+    from ..framework.desc import OpDesc
+    from ..framework.framework import grad_var_name
+    in_name = fwd.inputs["In"][0]
+    if in_name in no_grad_set:
+        return []
+    out_name = fwd.outputs["Out"][0]
+    return [OpDesc(type="assign",
+                   inputs={"X": [grad_var_name(out_name)]},
+                   outputs={"Out": [grad_var_name(in_name)]})]
+
+
+@op("print", grad=_print_grad_maker)
 def _print(ctx, op_, ins):
     """Debug print-through (reference print_op.cc): logs the tensor each
     step via a host callback (jax.debug.print — fires at RUN time inside

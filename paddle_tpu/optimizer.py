@@ -128,6 +128,12 @@ class Optimizer:
                                                  self.regularization)
         optimize_ops = self._create_optimization_pass(params_grads, loss,
                                                       startup_program)
+        from . import telemetry
+        telemetry.counter(
+            "optimizer_minimize_total",
+            "training graphs built (minimize calls), by optimizer type",
+            labels=("optimizer",)).labels(
+                optimizer=getattr(self, "type", type(self).__name__)).inc()
         return optimize_ops, params_grads
 
 

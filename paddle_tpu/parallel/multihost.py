@@ -25,7 +25,7 @@ from typing import Callable, Iterable, Optional
 
 __all__ = ["initialize", "shard_reader", "CheckpointableReader",
            "save_checkpoint", "load_checkpoint", "latest_checkpoint",
-           "is_save_leader"]
+           "is_save_leader", "allgather_bytes"]
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -49,7 +49,26 @@ def initialize(coordinator_address: Optional[str] = None,
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+    # telemetry snapshots label by host; export the id the same way the
+    # reference trainer env did so _host_index() needs no backend query
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(process_id))
+    from .. import telemetry
+    telemetry.counter("multihost_initialize_total",
+                      "jax.distributed bring-ups in this process").inc()
+    telemetry.gauge("multihost_processes",
+                    "process count of the multi-controller runtime") \
+        .set(num_processes)
     return True
+
+
+def allgather_bytes(payload: bytes) -> list:
+    """One bytes payload per process, gathered in process order (see
+    parallel/_collectives.py). The transport for fleet-wide telemetry
+    reduction: each host contributes its serialized metrics snapshot and
+    every host gets all of them back — one collective, no sidecar server,
+    the DCN analogue of scraping every pserver."""
+    from . import _collectives
+    return _collectives.process_allgather_bytes(payload)
 
 
 def shard_reader(reader: Callable[[], Iterable], num_shards=None,
@@ -188,6 +207,11 @@ def save_checkpoint(executor, dirname: str, step: int, main_program=None,
     with os.fdopen(fd, "w") as f:
         json.dump(meta, f)
     os.replace(tmp, os.path.join(dirname, _META))
+    from .. import telemetry
+    telemetry.counter("checkpoint_saves_total",
+                      "checkpoints written by this process").inc()
+    telemetry.gauge("checkpoint_last_step",
+                    "step of the newest checkpoint written").set(step)
     return True
 
 

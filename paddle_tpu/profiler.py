@@ -30,6 +30,13 @@ def record_event(name: str, seconds: float, start: Optional[float] = None):
         _events[name].append(seconds)
         if start is not None:
             _timeline.append((name, start - _epoch, seconds))
+        # publish into the shared registry too, so one telemetry snapshot
+        # answers both "which op eats the step" and "which step ate the
+        # minute" (ISSUE tentpole: profiler keeps its API, feeds telemetry)
+        from . import telemetry
+        telemetry.histogram(
+            "profiler_event_seconds", "host profiler event durations",
+            labels=("event",)).labels(event=name).observe(seconds)
 
 
 @contextlib.contextmanager
@@ -72,6 +79,11 @@ def export_chrome_trace(path: str):
 def start_profiler(state="All", trace_dir: Optional[str] = None):
     global _active
     _active = True
+    from . import telemetry
+    telemetry.counter(
+        "profiler_sessions_total", "profiling sessions started",
+        labels=("traced",)).labels(
+            traced=str(bool(trace_dir)).lower()).inc()
     _hlo_suppliers.clear()
     if trace_dir:
         jax.profiler.start_trace(trace_dir)
@@ -147,6 +159,12 @@ def _print_device_table(trace_dir, sorted_key=None):
         return
     rows = sorted(agg.items(), key=lambda kv: -kv[1])
     total = sum(agg.values())
+    from . import telemetry
+    for name, ps in rows:
+        telemetry.counter(
+            "device_op_seconds_total",
+            "device time attributed to IR ops across traced sessions",
+            labels=("op",)).labels(op=name).inc(ps / 1e12)
     print(f"{'Device op (jit)':40s} {'Total(ms)':>12s} {'Frac':>8s}")
     for name, ps in rows:
         print(f"[device] {name:31s} {ps / 1e9:12.4f} "

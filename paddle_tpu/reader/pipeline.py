@@ -66,9 +66,20 @@ class DoubleBufferedFeeder:
         self._queue.put(_STOP)
 
     def __iter__(self):
+        import time
+
+        from .. import telemetry
+        stall = telemetry.histogram(
+            "input_stall_seconds",
+            "consumer wait on the prefetch queue (0 when the producer "
+            "keeps ahead — the pipeline's headroom signal)")
+        batches = telemetry.counter(
+            "input_batches_total", "batches delivered by prefetch feeders")
         self.reset()
         while True:
+            t0 = time.perf_counter()
             item = self._queue.get()
+            stall.observe(time.perf_counter() - t0)
             if item is _STOP:
                 self._thread.join()
                 self._thread = None
@@ -77,6 +88,7 @@ class DoubleBufferedFeeder:
                 self._thread.join()
                 self._thread = None
                 raise item
+            batches.inc()
             yield item
 
     def reset(self):

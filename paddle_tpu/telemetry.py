@@ -1,0 +1,562 @@
+"""Unified telemetry: process-wide metrics registry + structured step log.
+
+The reference framework's observability is split across platform/profiler.cc
+(host event table), device_tracer.cc (CUPTI kernels) and tools/timeline.py
+(post-hoc trace merge). This module is the TPU-native consolidation: one
+process-wide registry of counters / gauges / log-scale histograms (labeled,
+Prometheus-exportable, fleet-reducible over hosts) plus a structured
+step-event log — one JSONL record per Executor.run with the
+compile-vs-execute split, donated-buffer stats and the shape/dtype
+signature that caused any jit retrace. `profiler.py` (host wall times) and
+`xplane.py` (device HLO attribution) keep their APIs but publish into this
+registry, so a single `snapshot()` answers both "which op eats the step"
+and "which step ate the minute".
+
+Hot-path cost: one lock + dict update per metric op; event logging is a
+dict build + deque append (and one JSON line when a sink is enabled).
+Everything is import-light — jax is only touched for the cross-host
+reduce and the compile-time listener, both lazily/guarded.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "counter", "gauge", "histogram", "registry", "MetricsRegistry",
+    "snapshot", "prometheus_text", "log_event", "recent_events",
+    "enable_step_log", "disable_step_log", "step_log_path", "read_step_log",
+    "export_chrome_trace", "default_buckets", "reset", "program_label",
+    "jax_compile_seconds", "signature_of",
+]
+
+
+# ---------------------------------------------------------------------------
+# Metric primitives
+# ---------------------------------------------------------------------------
+
+def default_buckets() -> Tuple[float, ...]:
+    """Fixed log-scale histogram buckets: powers of 4 from 1 microsecond to
+    ~67 seconds. Fixed (not adaptive) so bucket counts from different hosts
+    and different runs add cell-wise — the property the cross-host reduce
+    and Prometheus rate() queries rely on."""
+    return tuple(1e-6 * (4.0 ** i) for i in range(14))
+
+
+def _label_key(labels: Dict[str, str]) -> str:
+    """Canonical serialized label set — doubles as the cross-host merge key."""
+    return ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+
+
+# one lock for all series mutations: += on an attribute is a
+# read-modify-write and reader/feeder threads update concurrently with the
+# training loop; contention is negligible at per-step granularity
+_VALUES_LOCK = threading.Lock()
+
+
+class _Child:
+    """One (metric, label-values) time series."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0):
+        with _VALUES_LOCK:
+            self.value += amount
+
+    def set(self, value: float):
+        with _VALUES_LOCK:
+            self.value = float(value)
+
+
+class _HistChild:
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Sequence[float]):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)   # last = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float):
+        value = float(value)
+        with _VALUES_LOCK:
+            self.sum += value
+            self.count += 1
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+
+class _Family:
+    """A named metric with a fixed label-name schema; `.labels(**kw)`
+    resolves (and lazily creates) one child series per label-value tuple.
+    Label-free families proxy inc/set/observe to their single () child."""
+
+    kind = "counter"
+
+    def __init__(self, reg: "MetricsRegistry", name: str, help: str,
+                 labelnames: Sequence[str], buckets=None):
+        self._reg = reg
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._buckets = tuple(buckets) if buckets else None
+        self._children: Dict[Tuple[str, ...], Any] = {}
+
+    def _make_child(self):
+        if self.kind == "histogram":
+            return _HistChild(self._buckets or default_buckets())
+        return _Child()
+
+    def labels(self, **kw):
+        if set(kw) != set(self.labelnames):
+            raise ValueError(
+                f"metric '{self.name}' takes labels {self.labelnames}, "
+                f"got {sorted(kw)}")
+        key = tuple(str(kw[k]) for k in self.labelnames)
+        with self._reg._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child()
+            return child
+
+    def _default_child(self):
+        if self.labelnames:
+            raise ValueError(
+                f"metric '{self.name}' is labeled {self.labelnames}; "
+                f"use .labels(...)")
+        return self.labels()
+
+    # label-free conveniences
+    def inc(self, amount: float = 1.0):
+        self._default_child().inc(amount)
+
+    def set(self, value: float):
+        self._default_child().set(value)
+
+    def observe(self, value: float):
+        self._default_child().observe(value)
+
+    def series(self) -> Dict[str, Any]:
+        """{serialized-labels: child} snapshot view."""
+        with self._reg._lock:
+            return {_label_key(dict(zip(self.labelnames, k))): c
+                    for k, c in self._children.items()}
+
+
+class _Counter(_Family):
+    kind = "counter"
+
+
+class _Gauge(_Family):
+    kind = "gauge"
+
+
+class _Histogram(_Family):
+    kind = "histogram"
+
+
+class MetricsRegistry:
+    """Thread-safe name -> metric family registry. Re-registering the same
+    name with the same kind returns the existing family (idempotent, so
+    instrumented modules can declare metrics at call sites)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Family] = {}
+
+    def _get_or_make(self, cls, name, help, labels, buckets=None):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != cls.kind:
+                    raise ValueError(
+                        f"metric '{name}' already registered as {fam.kind}")
+                return fam
+            fam = cls(self, name, help, labels, buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> _Counter:
+        return self._get_or_make(_Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = ()) -> _Gauge:
+        return self._get_or_make(_Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "", labels: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> _Histogram:
+        return self._get_or_make(_Histogram, name, help, labels, buckets)
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return list(self._families.values())
+
+    def clear(self):
+        with self._lock:
+            self._families.clear()
+
+    # --- snapshots ----------------------------------------------------------
+    def local_snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable view of every series in this process."""
+        snap = {"host": _host_index(), "counters": {}, "gauges": {},
+                "histograms": {}}
+        for fam in self.families():
+            if fam.kind == "histogram":
+                dst = snap["histograms"].setdefault(fam.name, {})
+                for lk, ch in fam.series().items():
+                    with _VALUES_LOCK:   # counts/sum/count read consistently
+                        dst[lk] = {"buckets": list(ch.buckets),
+                                   "counts": list(ch.counts),
+                                   "sum": ch.sum, "count": ch.count}
+            else:
+                dst = snap[fam.kind + "s"].setdefault(fam.name, {})
+                for lk, ch in fam.series().items():
+                    dst[lk] = ch.value
+        return snap
+
+
+_REG = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REG
+
+
+def counter(name: str, help: str = "", labels: Sequence[str] = ()):
+    return _REG.counter(name, help, labels)
+
+
+def gauge(name: str, help: str = "", labels: Sequence[str] = ()):
+    return _REG.gauge(name, help, labels)
+
+
+def histogram(name: str, help: str = "", labels: Sequence[str] = (),
+              buckets: Optional[Sequence[float]] = None):
+    return _REG.histogram(name, help, labels, buckets)
+
+
+def _host_index() -> int:
+    # env-derived (reference PADDLE_TRAINER_ID): reading jax.process_index()
+    # here would force backend init from a metrics call
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+
+
+# ---------------------------------------------------------------------------
+# Cross-host reduce
+# ---------------------------------------------------------------------------
+
+def _merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Sum-merge per-host snapshots into fleet totals. Counters, histogram
+    cells and gauges all add — a reduced gauge is the fleet total (e.g.
+    per-host queue depths sum to fleet backlog); per-host values stay
+    available in the unreduced snapshot."""
+    out = {"hosts": len(snaps), "counters": {}, "gauges": {},
+           "histograms": {}}
+    for snap in snaps:
+        for kind in ("counters", "gauges"):
+            for name, series in snap.get(kind, {}).items():
+                dst = out[kind].setdefault(name, {})
+                for lk, v in series.items():
+                    dst[lk] = dst.get(lk, 0.0) + v
+        for name, series in snap.get("histograms", {}).items():
+            dst = out["histograms"].setdefault(name, {})
+            for lk, h in series.items():
+                acc = dst.get(lk)
+                if acc is None or list(acc["buckets"]) != list(h["buckets"]):
+                    if acc is None:
+                        dst[lk] = {"buckets": list(h["buckets"]),
+                                   "counts": list(h["counts"]),
+                                   "sum": h["sum"], "count": h["count"]}
+                    else:   # bucket-schema skew: keep first host's layout,
+                        acc["sum"] += h["sum"]         # fold scalars only
+                        acc["count"] += h["count"]
+                    continue
+                acc["counts"] = [a + b for a, b in
+                                 zip(acc["counts"], h["counts"])]
+                acc["sum"] += h["sum"]
+                acc["count"] += h["count"]
+    return out
+
+
+def snapshot(reduce: bool = False) -> Dict[str, Any]:
+    """Registry snapshot. reduce=True returns FLEET-WIDE totals: every
+    host's snapshot rides an allgather (parallel/_collectives.py) and the
+    series sum-merge by (metric, labels) — the multi-controller equivalent
+    of scraping every pserver and adding (single-process: identical to the
+    local snapshot)."""
+    local = _REG.local_snapshot()
+    if not reduce:
+        return local
+    from .parallel import multihost
+    payloads = multihost.allgather_bytes(
+        json.dumps(local, sort_keys=True).encode("utf-8"))
+    return _merge_snapshots([json.loads(p.decode("utf-8"))
+                             for p in payloads])
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+def _prom_labels(label_key: str, extra: str = "") -> str:
+    if not label_key and not extra:
+        return ""
+    parts = []
+    if label_key:
+        for pair in label_key.split(","):
+            k, _, v = pair.partition("=")
+            v = v.replace("\\", "\\\\").replace('"', '\\"')
+            parts.append(f'{k}="{v}"')
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}"
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Render a snapshot (default: local) in the Prometheus text exposition
+    format — counters/gauges as single samples, histograms as cumulative
+    `_bucket{le=...}` + `_sum` + `_count` (the scrape surface a serving
+    fleet sidecar exposes)."""
+    snap = snap if snap is not None else _REG.local_snapshot()
+    helps = {f.name: (f.help, f.kind) for f in _REG.families()}
+    lines: List[str] = []
+    for kind_key, prom_kind in (("counters", "counter"), ("gauges", "gauge")):
+        for name in sorted(snap.get(kind_key, {})):
+            help_, _ = helps.get(name, ("", prom_kind))
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {prom_kind}")
+            for lk in sorted(snap[kind_key][name]):
+                lines.append(f"{name}{_prom_labels(lk)} "
+                             f"{_fmt(snap[kind_key][name][lk])}")
+    for name in sorted(snap.get("histograms", {})):
+        help_, _ = helps.get(name, ("", "histogram"))
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} histogram")
+        for lk in sorted(snap["histograms"][name]):
+            h = snap["histograms"][name][lk]
+            cum = 0
+            for le, c in zip(list(h["buckets"]) + [math.inf], h["counts"]):
+                cum += c
+                le_label = 'le="%s"' % _fmt(le)
+                lines.append(
+                    f"{name}_bucket{_prom_labels(lk, le_label)} {cum}")
+            lines.append(f"{name}_sum{_prom_labels(lk)} {_fmt(h['sum'])}")
+            lines.append(f"{name}_count{_prom_labels(lk)} {h['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ---------------------------------------------------------------------------
+# Structured step-event log (JSONL)
+# ---------------------------------------------------------------------------
+
+_EVENTS_MAX = 4096
+_events: "collections.deque" = collections.deque(maxlen=_EVENTS_MAX)
+_events_lock = threading.Lock()
+_log_path: Optional[str] = None
+_log_file = None
+
+
+def enable_step_log(path: str):
+    """Mirror every event to `path` as one JSON line per event (in addition
+    to the in-memory ring buffer). Also settable via PADDLE_TPU_STEP_LOG."""
+    global _log_path, _log_file
+    with _events_lock:
+        if _log_file is not None:
+            _log_file.close()
+        _log_path = path
+        _log_file = open(path, "a", buffering=1)   # line-buffered
+
+
+def disable_step_log():
+    global _log_path, _log_file
+    with _events_lock:
+        if _log_file is not None:
+            _log_file.close()
+        _log_path = None
+        _log_file = None
+
+
+def step_log_path() -> Optional[str]:
+    return _log_path
+
+
+def log_event(kind: str, **fields) -> Dict[str, Any]:
+    """Record a structured event: wall timestamp + monotonic timestamp
+    (perf_counter, merge key for the chrome-trace export) + host + kind +
+    caller fields. Returns the record."""
+    rec = {"ts": time.time(), "mono": time.perf_counter(),
+           "host": _host_index(), "kind": kind}
+    rec.update(fields)
+    with _events_lock:
+        _events.append(rec)
+        if _log_file is not None:
+            try:
+                _log_file.write(json.dumps(rec, default=str) + "\n")
+            except (OSError, ValueError):
+                pass    # a torn sink must never kill the training step
+    return rec
+
+
+def recent_events(n: Optional[int] = None,
+                  kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    with _events_lock:
+        evs = list(_events)
+    if kind is not None:
+        evs = [e for e in evs if e.get("kind") == kind]
+    return evs[-n:] if n else evs
+
+
+def read_step_log(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL step log; tolerates a torn final line (crash mid-write)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+if os.environ.get("PADDLE_TPU_STEP_LOG"):
+    enable_step_log(os.environ["PADDLE_TPU_STEP_LOG"])
+
+
+# ---------------------------------------------------------------------------
+# Merged chrome-trace export
+# ---------------------------------------------------------------------------
+
+def export_chrome_trace(path: str, events: Optional[Iterable[Dict]] = None):
+    """One Perfetto-loadable timeline with BOTH telemetry step events (run /
+    compile / cache_miss rows, tid 1) and the profiler's host events (tid 0)
+    — the merged view the reference's tools/timeline.py produced from
+    separate host+device dumps. Both sources share the perf_counter
+    timebase ('mono' here, profiler._epoch there)."""
+    from . import profiler as profiler_mod
+    epoch = profiler_mod._epoch
+    trace = [{"name": name, "ph": "X", "pid": 0, "tid": 0,
+              "ts": start * 1e6, "dur": dur * 1e6, "cat": "host"}
+             for name, start, dur in profiler_mod._timeline]
+    for e in (events if events is not None else recent_events()):
+        dur = float(e.get("seconds", 0.0) or 0.0)
+        start = float(e.get("mono", 0.0)) - epoch - dur
+        args = {k: v for k, v in e.items()
+                if k not in ("mono", "kind") and _json_ok(v)}
+        trace.append({"name": e.get("kind", "event"), "ph": "X",
+                      "pid": 0, "tid": 1, "ts": start * 1e6,
+                      "dur": dur * 1e6, "cat": "step", "args": args})
+    with open(path, "w") as f:
+        json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+def _json_ok(v) -> bool:
+    return isinstance(v, (str, int, float, bool, list, tuple, type(None)))
+
+
+# ---------------------------------------------------------------------------
+# Executor-facing helpers
+# ---------------------------------------------------------------------------
+
+_prog_labels: Dict[int, str] = {}
+_prog_seq = [0]
+
+
+def program_label(program) -> str:
+    """Stable short label for a Program within this process ("p0", "p1"…)
+    — id() is unreadable and Programs carry no user-facing name."""
+    lbl = getattr(program, "_telemetry_label", None)
+    if lbl is None:
+        lbl = f"p{_prog_seq[0]}"
+        _prog_seq[0] += 1
+        try:
+            program._telemetry_label = lbl
+        except AttributeError:
+            pass
+    return lbl
+
+
+def signature_of(feed_vals: Dict[str, Any]) -> Tuple[Tuple[str, str, str], ...]:
+    """(name, shape, dtype) triples for a feed dict — the retrace identity:
+    jax.jit keys its trace cache on exactly these avals."""
+    sig = []
+    for name in sorted(feed_vals):
+        v = feed_vals[name]
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        sig.append((name, str(tuple(shape) if shape is not None else ()),
+                    str(dtype)))
+    return tuple(sig)
+
+
+# Accumulated backend-compile seconds, fed by jax.monitoring: XLA fires
+# '/jax/core/compile/backend_compile_duration' for every real compilation
+# (including jit retraces the executor-level cache can't see). Reading the
+# accumulator before/after a run call splits that run's wall time into
+# compile vs execute without AOT-lowering anything.
+_compile_secs = [0.0]
+_compile_listener_installed = [False]
+
+
+def _install_compile_listener():
+    if _compile_listener_installed[0]:
+        return
+    _compile_listener_installed[0] = True
+    try:
+        import jax.monitoring
+
+        def _on_duration(name, secs, **kw):
+            if name.endswith("backend_compile_duration"):
+                _compile_secs[0] += float(secs)
+                counter("jax_backend_compile_seconds_total",
+                        "XLA backend compile wall seconds").inc(float(secs))
+                counter("jax_backend_compiles_total",
+                        "XLA backend compilations").inc()
+
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:   # jax absent/too old: compile split degrades to 0
+        pass
+
+
+def jax_compile_seconds() -> float:
+    """Monotone accumulator of XLA backend-compile seconds in this process."""
+    _install_compile_listener()
+    return _compile_secs[0]
+
+
+_install_compile_listener()
+
+
+def reset():
+    """Clear every metric series and the in-memory event buffer (tests).
+    The JSONL sink, program labels and the compile accumulator survive —
+    they are process-lifetime state."""
+    _REG.clear()
+    with _events_lock:
+        _events.clear()

@@ -81,8 +81,12 @@ class ThreadPool:
                     "ThreadPool is shut down — tasks queued now would "
                     "never run and their futures would never resolve")
             self._pending.add(fut)
+            # enqueue under the same lock that shutdown() takes: a task
+            # that passed the closed check must land in the queue BEFORE
+            # the _SHUTDOWN sentinels, or it would sit behind them forever
+            # (workers exit on sentinel) and hang wait()
+            self._tasks.put((fut, fn, args, kwargs))
         fut.add_done_callback(self._untrack)
-        self._tasks.put((fut, fn, args, kwargs))
         return fut
 
     def _untrack(self, fut):
@@ -124,8 +128,8 @@ class ThreadPool:
     def shutdown(self):
         with self._lock:
             self._closed = True
-        for _ in self._workers:
-            self._tasks.put(_SHUTDOWN)
+            for _ in self._workers:
+                self._tasks.put(_SHUTDOWN)
 
     # reference-style capitalized aliases
     Run = run

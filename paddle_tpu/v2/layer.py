@@ -1013,12 +1013,21 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
                                bias_attr=False)
 
 
-def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
-                  stride=1, padding=0, **kw):
+def conv_operator(img, filter=None, filter_size=None, num_filters=None,
+                  num_channels=None, stride=1, padding=0, **kw):
     """Convolution inside mixed() (reference conv_operator: like
-    conv_projection but positioned as a two-input operator; the filter
-    input is accepted for signature parity — parameters are created
-    internally like every projection here)."""
+    conv_projection but positioned as a two-input operator). The reference
+    convolves `img` with the `filter` layer's OUTPUT; here parameters are
+    created internally like every projection, so a caller-supplied filter
+    would be silently replaced by fresh weights — raise instead, per this
+    module's raise-on-silent-drift policy."""
+    if filter is not None:
+        raise ValueError(
+            "conv_operator: a `filter` input layer is not supported — the "
+            "TPU port creates the convolution parameters internally "
+            "(conv_projection semantics), so the supplied filter would be "
+            "silently ignored and fresh weights trained in its place. "
+            "Pass filter=None and use param_attr to control the weights.")
     _split_kw(kw, "conv_operator")
     return conv_projection(img, filter_size=filter_size,
                            num_filters=num_filters,

@@ -5,7 +5,7 @@ this image can't load them (TF version skew), so this decodes the wire
 format directly — only the fields needed to aggregate device-op time:
 
   XSpace.planes=1 / XPlane{name=2, lines=3, event_metadata=4}
-  XLine{events=6} / XEvent{metadata_id=1, duration_ps=3}
+  XLine{events=4} / XEvent{metadata_id=1, duration_ps=3}
   XEventMetadata map entry {key=1, value=2} / XEventMetadata{id=1, name=2}
 
 The executor wraps every IR op's lowering in jax.named_scope("pd.<type>")
@@ -24,8 +24,8 @@ import os
 import re
 from typing import Dict, Optional
 
-__all__ = ["aggregate", "aggregate_dir", "hlo_op_names", "attribute",
-           "category", "fields", "parse_plane"]
+__all__ = ["aggregate", "aggregate_dir", "aggregate_lines", "hlo_op_names",
+           "attribute", "category", "fields", "parse_plane"]
 
 
 def _varint(buf, i):
@@ -90,16 +90,20 @@ def parse_plane(buf):
     return name, lines, meta
 
 
-def aggregate(path) -> Dict[str, Dict[str, int]]:
-    """-> {plane_name: {event_name: total_ps}}"""
+def aggregate_lines(path) -> Dict[str, list]:
+    """-> {plane_name: [{event_name: total_ps} per XLine]} — per-line
+    aggregation so callers can dedup a plane's derived lines (xplane device
+    planes repeat each instruction on the raw XLA-op line AND on derived
+    step/module/framework-op lines)."""
     buf = open(path, "rb").read()
-    out = {}
+    out: Dict[str, list] = {}
     for fno, wt, v in fields(buf):
         if fno != 1 or wt != 2:
             continue
         pname, lines, meta = parse_plane(v)
-        agg = out.setdefault(pname, {})
+        per_line = out.setdefault(pname, [])
         for line in lines:
+            agg: Dict[str, int] = {}
             for f2, w2, v2 in fields(line):
                 if f2 != 4 or w2 != 2:   # XLine.events
                     continue
@@ -111,20 +115,49 @@ def aggregate(path) -> Dict[str, Dict[str, int]]:
                         dur = v3
                 name = meta.get(mid, f"#{mid}")
                 agg[name] = agg.get(name, 0) + dur
+            per_line.append(agg)
+    return out
+
+
+def aggregate(path) -> Dict[str, Dict[str, int]]:
+    """-> {plane_name: {event_name: total_ps}} (lines summed)."""
+    out = {}
+    for pname, per_line in aggregate_lines(path).items():
+        agg = out.setdefault(pname, {})
+        for line_agg in per_line:
+            for name, ps in line_agg.items():
+                agg[name] = agg.get(name, 0) + ps
     return out
 
 
 def aggregate_dir(trace_dir) -> Dict[str, int]:
-    """Merge every plane of every .xplane.pb under trace_dir into ONE
-    {event_name: total_ps} map (device planes hold the HLO instruction
-    events; host-side junk events simply never match the HLO mapping)."""
-    merged: Dict[str, int] = {}
+    """Merge the DEVICE planes of every .xplane.pb under trace_dir into ONE
+    {event_name: total_ps} map. Within a device plane an instruction shows
+    up once per line that mentions it (raw XLA-op line + derived
+    step/module lines), so per plane we take the per-name MAX across lines
+    — one line's worth, not the double-counted sum — then sum across planes
+    (per-core time adds up) and files.
+
+    Fallback: traces with no '/device:' plane at all (e.g. CPU-backend jax
+    writes only host planes) keep the old all-planes line-summed merge so
+    the table still has rows to join against the HLO mapping."""
+    device: Dict[str, int] = {}
+    host: Dict[str, int] = {}
     for p in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                        recursive=True):
-        for agg in aggregate(p).values():
-            for name, ps in agg.items():
-                merged[name] = merged.get(name, 0) + ps
-    return merged
+        for pname, per_line in aggregate_lines(p).items():
+            if pname.startswith("/device:"):
+                plane: Dict[str, int] = {}
+                for line_agg in per_line:
+                    for name, ps in line_agg.items():
+                        plane[name] = max(plane.get(name, 0), ps)
+                for name, ps in plane.items():
+                    device[name] = device.get(name, 0) + ps
+            else:
+                for line_agg in per_line:
+                    for name, ps in line_agg.items():
+                        host[name] = host.get(name, 0) + ps
+    return device if device else host
 
 
 _HLO_LINE = re.compile(

@@ -1,0 +1,63 @@
+"""Child process for test_telemetry's multihost-reduce test: a real
+2-process jax.distributed bring-up (same harness as _distributed_worker.py)
+where each process populates distinct metric values and asserts that
+telemetry.snapshot(reduce=True) returns the fleet-wide sums on BOTH sides.
+
+Run as:  python _telemetry_worker.py <coordinator> <nprocs> <pid>
+
+Prints one line `RESULT <json>` on success."""
+
+import json
+import os
+import sys
+
+
+def main(coordinator, nprocs, pid):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu import telemetry
+    from paddle_tpu.parallel import multihost
+
+    assert multihost.initialize(coordinator_address=coordinator,
+                                num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs
+
+    # initialize() exported the process id, so snapshots label correctly
+    assert telemetry._host_index() == pid
+
+    # distinct per-process contributions: counter pid+1, one gauge each,
+    # one histogram observation each
+    telemetry.counter("tw_steps_total", labels=("role",)) \
+        .labels(role="trainer").inc(pid + 1)
+    telemetry.gauge("tw_queue_depth").set(10.0 * (pid + 1))
+    telemetry.histogram("tw_lat_seconds").observe(0.001 * (pid + 1))
+
+    local = telemetry.snapshot()
+    assert local["counters"]["tw_steps_total"]["role=trainer"] == pid + 1
+
+    fleet = telemetry.snapshot(reduce=True)
+    want_counter = sum(range(1, nprocs + 1))          # 1+2+...+n
+    got_counter = fleet["counters"]["tw_steps_total"]["role=trainer"]
+    assert got_counter == want_counter, (got_counter, want_counter)
+    want_gauge = 10.0 * want_counter
+    got_gauge = fleet["gauges"]["tw_queue_depth"][""]
+    assert got_gauge == want_gauge, (got_gauge, want_gauge)
+    h = fleet["histograms"]["tw_lat_seconds"][""]
+    assert h["count"] == nprocs, h
+    assert abs(h["sum"] - 0.001 * want_counter) < 1e-9, h
+    assert fleet["hosts"] == nprocs, fleet
+
+    # the fleet snapshot renders through the same exporter
+    text = telemetry.prometheus_text(fleet)
+    assert f'tw_steps_total{{role="trainer"}} {want_counter}' in text, text
+
+    print(f"RESULT {json.dumps({'pid': pid, 'counter': got_counter})}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
