@@ -51,6 +51,7 @@ from . import concurrency
 from .concurrency import (make_channel, channel_send, channel_recv,
                           channel_close, Go, Select)
 from . import telemetry
+from . import inspector
 from .parallel import transpiler
 from .parallel.transpiler import DistributeTranspiler
 

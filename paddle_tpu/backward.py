@@ -78,7 +78,7 @@ _ZERO_GRAD_SAFE = frozenset({
     "one_hot", "uniform_random", "gaussian_random",
     "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
     "sign", "arg_max", "arg_min", "crf_decoding", "ctc_align",
-    "sequence_mask", "prior_box",
+    "sequence_mask", "prior_box", "tensor_stats",
 })
 
 _INT_DTYPES = ("bool", "int8", "uint8", "int16", "int32", "int64")
@@ -131,6 +131,15 @@ def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
     block = program.global_block()
     assert loss.block.idx == 0, "loss must live in the root block"
     no_grad = _collect_no_grad(block, no_grad_set)
+
+    # record the loss var on the program: the inspector's auto probe mode
+    # targets loss and grad vars, and grad_info_map alone cannot say which
+    # forward var was the differentiation root
+    losses = getattr(program, "_loss_names", None)
+    if losses is None:
+        losses = program._loss_names = []
+    if loss.name not in losses:
+        losses.append(loss.name)
 
     rel = _relevant_op_indices(block, loss.name)
 

@@ -297,6 +297,22 @@ def cmd_telemetry(args):
     return 0
 
 
+def cmd_inspect(args):
+    """Read back a flight-recorder crash report (inspector.py): the JSON a
+    crashed run leaves behind, rendered as the post-mortem a human wants —
+    error + attributed origin + last recorded steps."""
+    import json
+
+    from paddle_tpu import inspector
+
+    report = inspector.read_crash_report(args.dump)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    print(inspector.format_crash_report(report, show_program=args.program))
+    return 0
+
+
 def _fmt_num(v: float) -> str:
     return f"{int(v)}" if float(v).is_integer() else f"{v:.6g}"
 
@@ -359,6 +375,16 @@ def main(argv=None):
     p_tel.add_argument("--reduce", action="store_true",
                        help="allreduce the snapshot across hosts first")
     p_tel.set_defaults(fn=cmd_telemetry)
+
+    p_ins = sub.add_parser(
+        "inspect", help="read a flight-recorder crash report")
+    p_ins.add_argument("dump", help="crash-report JSON written by the "
+                                    "inspector flight recorder")
+    p_ins.add_argument("--json", action="store_true",
+                       help="print the raw report JSON instead of a summary")
+    p_ins.add_argument("--program", action="store_true",
+                       help="include the recorded program dump")
+    p_ins.set_defaults(fn=cmd_inspect)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

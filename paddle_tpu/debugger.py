@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import shutil
 import subprocess
+import warnings
 from typing import Optional
 
 __all__ = ["pprint_program", "draw_program"]
@@ -93,7 +94,25 @@ def draw_program(program, path: Optional[str] = None, block_idx: int = 0,
             f.write(dot)
         if render and shutil.which("dot"):
             for fmt in ("pdf", "png"):
-                subprocess.run(["dot", f"-T{fmt}", path, "-o",
-                                f"{path}.{fmt}"], check=False,
-                               capture_output=True)
+                # a broken graphviz install (dot present but exiting
+                # non-zero, or failing to exec) must not take down the
+                # caller: the .dot source above is already on disk, so warn
+                # and fall back to it
+                try:
+                    proc = subprocess.run(
+                        ["dot", f"-T{fmt}", path, "-o", f"{path}.{fmt}"],
+                        check=False, capture_output=True)
+                except OSError as e:
+                    warnings.warn(
+                        f"graphviz 'dot' could not be executed ({e}); "
+                        f"DOT source written to {path} only", RuntimeWarning)
+                    break
+                if proc.returncode != 0:
+                    err = proc.stderr.decode("utf-8", "replace").strip()
+                    warnings.warn(
+                        f"'dot -T{fmt}' exited with status {proc.returncode}"
+                        + (f": {err[:200]}" if err else "")
+                        + f"; DOT source written to {path} only",
+                        RuntimeWarning)
+                    break
     return dot

@@ -7,7 +7,7 @@ reference paddle/utils/CustomStackTrace.h layer-stack dump)."""
 
 from __future__ import annotations
 
-__all__ = ["EnforceNotMet", "EOFException", "NotFoundError"]
+__all__ = ["EnforceNotMet", "EOFException", "NonFiniteError", "NotFoundError"]
 
 
 class EnforceNotMet(RuntimeError):
@@ -17,6 +17,47 @@ class EnforceNotMet(RuntimeError):
         super().__init__(message)
         self.op_type = op_type
         self.creation_site = creation_site
+
+
+class NonFiniteError(FloatingPointError, RuntimeError):
+    """A NaN/Inf was detected in a tensor (reference FLAGS_check_nan_inf,
+    executor.cc:325 CheckTensorNANOrInf). Subclasses both FloatingPointError
+    (the eager per-op check's historical type) and RuntimeError (the jit
+    fetch-level check's), so existing handlers keep working.
+
+    Structured fields localize the origin: `var_name`/`dtype` name the tensor
+    the detection fired on, `op_type`/`op_index` the producing op when known,
+    `stats` its inspector.TensorStats, and `attribution` the full
+    inspector.Attribution from the bisection re-run (None when attribution is
+    disabled or inconclusive)."""
+
+    def __init__(self, message, var_name=None, dtype=None, op_type=None,
+                 op_index=None, stats=None, attribution=None,
+                 feed_signature=None):
+        super().__init__(message)
+        self.var_name = var_name
+        self.dtype = dtype
+        self.op_type = op_type
+        self.op_index = op_index
+        self.stats = stats
+        self.attribution = attribution
+        self.feed_signature = feed_signature
+
+    def to_dict(self):
+        """JSON-serializable view (flight-recorder crash reports)."""
+        return {
+            "type": type(self).__name__,
+            "message": str(self),
+            "var_name": self.var_name,
+            "dtype": self.dtype,
+            "op_type": self.op_type,
+            "op_index": self.op_index,
+            "stats": self.stats.to_dict() if self.stats is not None else None,
+            "attribution": (self.attribution.to_dict()
+                            if self.attribution is not None else None),
+            "feed_signature": ([list(s) for s in self.feed_signature]
+                               if self.feed_signature else None),
+        }
 
 
 class NotFoundError(KeyError):

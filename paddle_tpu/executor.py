@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import flags as flags_mod
 from . import telemetry
 from .framework.desc import VarType
 from .framework.framework import Program, Variable, default_main_program
@@ -282,10 +283,21 @@ _VLOG_LEVEL = int(os.environ.get("PADDLE_TPU_VLOG", "0") or 0)
 _TELEMETRY_FETCH = os.environ.get("PADDLE_TPU_TELEMETRY_FETCH", "1") == "1"
 
 
+def _vlog_level() -> int:
+    """Live verbosity: the flags registry re-reads PADDLE_TPU_VLOG on every
+    call, so flags.set("vlog", n) changes vlog() output at runtime (the
+    import-time _VLOG_LEVEL snapshot is only the fallback if the registry
+    is unavailable mid-interpreter-teardown)."""
+    try:
+        return int(flags_mod.get("vlog"))
+    except Exception:
+        return _VLOG_LEVEL
+
+
 def vlog(level: int, msg: str):
     """glog-style leveled logging (reference VLOG; enable with
-    PADDLE_TPU_VLOG=<level>)."""
-    if level <= _VLOG_LEVEL:
+    PADDLE_TPU_VLOG=<level> or flags.set("vlog", n) at runtime)."""
+    if level <= _vlog_level():
         import datetime
         ts = datetime.datetime.now().strftime("%H:%M:%S.%f")[:-3]
         print(f"V{level} {ts} paddle_tpu] {msg}", file=sys.stderr)
@@ -475,6 +487,22 @@ class Executor:
             return_numpy: bool = True, use_program_cache: bool = True,
             use_jit: Optional[bool] = None):
         program = program if program is not None else default_main_program()
+        try:
+            return self._run_impl(program, feed, fetch_list, feed_var_name,
+                                  fetch_var_name, scope, return_numpy,
+                                  use_program_cache, use_jit)
+        except Exception as e:
+            # flight-recorder crash hook: a no-op unless the recorder is
+            # enabled (inspector.enable_flight_recorder or the
+            # PADDLE_TPU_FLIGHT_RECORDER flag); writes the JSON crash report
+            # before the exception propagates
+            from . import inspector as inspector_mod
+            inspector_mod.notify_crash(self, program, e)
+            raise
+
+    def _run_impl(self, program, feed, fetch_list, feed_var_name,
+                  fetch_var_name, scope, return_numpy, use_program_cache,
+                  use_jit):
         feed = dict(feed or {})
         # program-bound reader pipelines (layers.read_file): when the caller
         # gives no explicit feed for the reader vars, pull the next
@@ -512,6 +540,23 @@ class Executor:
                 extra_fetch = [(m, n) for m, n in sorted(marked.items())
                                if n not in fetch_names]
                 fetch_names = fetch_names + [n for _, n in extra_fetch]
+
+        # inspector probes (inspector.instrument / GradientAudit): their
+        # stat vectors are fetched with the user's list, so the probed step
+        # stays one jitted computation and one device round-trip. Replay
+        # programs built by the inspector itself (_inspector_internal) fetch
+        # explicitly and skip all recording/raising to avoid recursion.
+        internal_run = bool(getattr(program, "_inspector_internal", False))
+        probe_sites = getattr(program, "_probe_sites", None) or None
+        if probe_sites and not internal_run:
+            fetch_names = fetch_names + [s.stat_var for s in probe_sites]
+        else:
+            probe_sites = None
+        # check_nan_inf is live: the import-time snapshot (kept because
+        # tests/tools monkeypatch it) OR the flag registry's current value,
+        # so flags.set("check_nan_inf", True) takes effect mid-session
+        check_nan = (_CHECK_NAN_INF or flags_mod.get("check_nan_inf")) \
+            and not internal_run
 
         # Normalize feeds. LoDTensor feeds with a LoD become padded dense
         # arrays plus a `<name>@SEQLEN` lengths input (pack_to_padded) — the
@@ -639,17 +684,26 @@ class Executor:
                     labels=("program", "place")).labels(
                         program=prog_label, place=place_label).inc()
             compiled.last_sig = sig
-            if _CHECK_NAN_INF:
+            if check_nan:
                 # jit-path equivalent of the reference FLAGS_check_nan_inf
                 # per-op scan (executor.cc:325-333): inside one fused XLA
                 # computation there is no per-op boundary, so the check runs
                 # on every fetch and updated persistable after the step.
-                for name, val in list(zip(fetch_names, fetch_vals)) +                         list(new_state.items()):
+                # Probe stat vectors are exempt: their counts describe OTHER
+                # tensors (record_probes inspects them below), and a stats
+                # l2 that overflowed to inf must not masquerade as a hit.
+                probe_stat_names = ({s.stat_var for s in probe_sites}
+                                    if probe_sites else ())
+                for name, val in list(zip(fetch_names, fetch_vals)) + \
+                        list(new_state.items()):
+                    if name in probe_stat_names:
+                        continue
                     arr = np.asarray(val)
-                    if np.issubdtype(arr.dtype, np.floating) and                             not np.isfinite(arr).all():
-                        raise RuntimeError(
-                            f"NaN/Inf detected in variable '{name}' after "
-                            f"jitted step (PADDLE_TPU_CHECK_NAN_INF=1)")
+                    if np.issubdtype(arr.dtype, np.floating) and \
+                            not np.isfinite(arr).all():
+                        self._raise_nonfinite(
+                            program, name, arr, feed, new_state,
+                            rng_counter, scope, prog_label)
         else:
             seed = program.random_seed or 12345
             rng_key = jax.random.fold_in(jax.random.key(seed), rng_counter)
@@ -657,10 +711,25 @@ class Executor:
             run_t0 = time.perf_counter()
             fetch_vals, fetch_lens, new_state = self._run_eager(
                 program, feed_vals, state_vals, fetch_names, persist_out,
-                rng_key, lod_map)
+                rng_key, lod_map, check_nan=check_nan)
             run_dt = time.perf_counter() - run_t0
             compile_s = telemetry.jax_compile_seconds() - compile_before
             mode, donated, cache_status = "eager", 0, "n/a"
+
+        if probe_sites:
+            # pop the probe stat vectors (appended after the telemetry
+            # extras) and hand them to the inspector BEFORE state writeback:
+            # a non-finite probe raises here, so a diverged step never
+            # commits its state to the scope
+            n_keep = n_user_fetch + len(extra_fetch)
+            probe_vals = fetch_vals[n_keep:]
+            fetch_vals = fetch_vals[:n_keep]
+            fetch_names = fetch_names[:n_keep]
+            from . import inspector as inspector_mod
+            inspector_mod.record_probes(
+                self, program, scope, probe_sites, probe_vals, feed=feed,
+                new_state=new_state, rng_counter=rng_counter,
+                prog_label=prog_label)
 
         telemetry.counter(
             "executor_runs_total", "Executor.run calls",
@@ -710,6 +779,19 @@ class Executor:
                     pass
             fetch_vals = fetch_vals[:n_user_fetch]
             fetch_names = fetch_names[:n_user_fetch]
+        if not internal_run:
+            from . import inspector as inspector_mod
+            if inspector_mod.flight_enabled():
+                # flight recorder: one bounded ring record per step (after
+                # the gauge pop above so the global norm is this step's)
+                inspector_mod.record_step(program, prog_label, {
+                    "place": place_label, "mode": mode, "seconds": run_dt,
+                    "compile_s": compile_s, "cache": cache_status,
+                    "feeds": len(feed_vals), "fetches": n_user_fetch,
+                    "rng_counter": int(rng_counter),
+                    "global_norm": telemetry.read_gauge(
+                        "optimizer_global_norm", program=prog_label),
+                })
         # Fetched sequence vars come back in the reference's packed layout
         # ([sum_len, ...] rows): numpy mode returns the packed array, LoDTensor
         # mode additionally carries the offsets.
@@ -742,6 +824,39 @@ class Executor:
             else:
                 rebuilt.append(arr if return_numpy else v)
         return rebuilt
+
+    def _raise_nonfinite(self, program, name, arr, feed, new_state,
+                         rng_counter, scope, prog_label):
+        """Structured error for a fetch-level check_nan_inf hit: names the
+        offending fetch var and dtype, counts the contamination, and (when
+        the nonfinite_attribution flag is on) replays the step with
+        bisection probes to name the first offending op."""
+        from . import inspector as inspector_mod
+        from .errors import NonFiniteError
+        telemetry.counter(
+            "nonfinite_detections_total",
+            "NaN/Inf values caught by check_nan_inf or inspector probes",
+            labels=("program", "source")).labels(
+                program=prog_label, source="fetch").inc()
+        nan_c = int(np.isnan(arr).sum())
+        inf_c = int(np.isinf(arr).sum())
+        msg = (f"NaN/Inf detected in variable '{name}' (dtype {arr.dtype}, "
+               f"shape {tuple(arr.shape)}, {nan_c} NaN / {inf_c} Inf) "
+               f"after jitted step (check_nan_inf)")
+        attribution = None
+        if flags_mod.get("nonfinite_attribution"):
+            try:
+                attribution = inspector_mod.attribute_nonfinite(
+                    self, program, feed, scope=scope, state=new_state,
+                    rng_counter=rng_counter)
+            except Exception:
+                attribution = None
+            if attribution is not None:
+                msg += "\n  " + attribution.summary()
+        raise NonFiniteError(msg, var_name=name, dtype=str(arr.dtype),
+                             attribution=attribution,
+                             feed_signature=inspector_mod.feed_signature(
+                                 feed))
 
     def close(self):
         self._cache.clear()
@@ -1077,7 +1192,7 @@ class Executor:
                               program)
 
     def _run_eager(self, program, feed_vals, state_vals, fetch_names,
-                   persist_out, rng_key, lod_map):
+                   persist_out, rng_key, lod_map, check_nan=False):
         env: Dict[str, Any] = {}
         env.update({k: jnp.asarray(v) for k, v in state_vals.items()})
         env.update({k: jnp.asarray(v) for k, v in feed_vals.items()})
@@ -1089,14 +1204,23 @@ class Executor:
             # RecordEvent around each kernel launch, operator.cc:486)
             with profiler_mod.record(op.type):
                 self._exec_op(ctx, op, env)
-            if _CHECK_NAN_INF:
+            if check_nan and op.type != "tensor_stats":
+                # per-op scan (reference executor.cc:325 FLAGS_check_nan_inf
+                # semantics); in eager mode the op boundary IS available, so
+                # the error names the producing op directly — no bisection
+                # replay needed. tensor_stats outputs are exempt for the
+                # same reason as in the jit path.
                 for name in op.output_arg_names:
                     v = env.get(name)
                     if v is not None and jnp.issubdtype(
                             jnp.asarray(v).dtype, jnp.inexact):
                         if not bool(jnp.all(jnp.isfinite(v))):
-                            raise FloatingPointError(
-                                f"NaN/Inf in output '{name}' of op {op.type}")
+                            from .errors import NonFiniteError
+                            raise NonFiniteError(
+                                f"NaN/Inf in output '{name}' of op "
+                                f"{op.type}",
+                                var_name=name, op_type=op.type,
+                                dtype=str(jnp.asarray(v).dtype))
         if ctx.layouts:
             from .ops import layout as layout_mod
             layout_mod.canonicalize(ctx.layouts, env,

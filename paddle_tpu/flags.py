@@ -6,10 +6,14 @@ gflags' --name=value), and queryable at runtime:
 
     from paddle_tpu import flags
     flags.get("check_nan_inf")      # -> bool
+    flags.set("check_nan_inf", True)  # runtime toggle (writes the env var)
     flags.dump()                    # -> {name: (value, help)}
 
-Modules keep reading their flags at import time for zero overhead; this
+Most modules keep reading their flags at import time for zero overhead; this
 registry is the single catalogue of what exists (reference Flags.cpp role).
+A growing set of flags is *live* — re-read through get() on every use, so
+set() changes behavior at runtime: `vlog`, `check_nan_inf`,
+`nonfinite_attribution`, `flight_recorder` (executor.py / inspector.py).
 """
 
 from __future__ import annotations
@@ -50,6 +54,27 @@ def get(name: str):
     if raw is None:
         return default
     return _parse(raw, t, default)
+
+
+def set(name: str, value):
+    """Set a flag at runtime by writing its `PADDLE_TPU_<NAME>` env var (so
+    child processes inherit it, matching how gflags values propagate through
+    the environment in the reference's distributed launchers). Live-read
+    flags (vlog, check_nan_inf, nonfinite_attribution, flight_recorder)
+    react immediately; import-snapshot consumers keep their old value —
+    dump() annotates the divergence. `value=None` unsets the env var,
+    restoring the registered default. Returns the new effective value."""
+    default, t, _ = _REGISTRY[name]
+    env = f"PADDLE_TPU_{name.upper()}"
+    if value is None:
+        os.environ.pop(env, None)
+        return default
+    if t is bool:
+        raw = "1" if value not in (False, 0, "0", "") else "0"
+    else:
+        raw = str(t(value))
+    os.environ[env] = raw
+    return get(name)
 
 
 def snapshot(name: str):
@@ -99,3 +124,18 @@ define("xla_cache", "",
 define("max_loop_iters", 128,
        "default while-loop step-scope recording capacity "
        "(While(max_iters=...) overrides per loop)")
+define("nonfinite_attribution", True,
+       "on NaN/Inf detection, replay the step with bisection probes to "
+       "name the first offending op (inspector.attribute_nonfinite); "
+       "live-read, 0 disables the extra replay runs")
+define("flight_recorder", "",
+       "path: enable the inspector flight recorder; a JSON crash report "
+       "is written there on executor exception or fatal signal "
+       "(inspector.enable_flight_recorder)")
+define("step_log", "",
+       "JSONL step-event log path (telemetry.enable_step_log; read back "
+       "with telemetry.read_step_log / the `telemetry` CLI)")
+define("telemetry_fetch", True,
+       "fetch program._telemetry_fetch_extra side-outputs (e.g. the clip "
+       "pass's global norm) alongside user fetches; 0 skips the per-step "
+       "device->host read for latency-critical loops")

@@ -458,9 +458,22 @@ class Program:
         # AMP policy, bound reader pipelines
         p._mesh = getattr(self, "_mesh", None)
         for attr in ("_amp_dtype", "_amp_level", "_pipeline_readers",
-                     "_param_shardings"):
+                     "_param_shardings", "_feed_shardings",
+                     # observability state: telemetry side-fetch marks, loss
+                     # names recorded by append_backward, inspector probe
+                     # sites / audit / internal-run marker — all describe the
+                     # desc being copied, so they ride along (dict/list
+                     # values shallow-copied so mutating the clone's map
+                     # never leaks back)
+                     "_telemetry_fetch_extra", "_loss_names", "_probe_sites",
+                     "_probe_parent", "_grad_audit", "_inspector_internal"):
             if hasattr(self, attr):
-                setattr(p, attr, getattr(self, attr))
+                val = getattr(self, attr)
+                if isinstance(val, (dict, list)):
+                    val = copy.copy(val)
+                setattr(p, attr, val)
+        if self.grad_info_map:
+            p.grad_info_map = dict(self.grad_info_map)
         p.blocks = [Block(p, i) for i in range(len(p.desc.blocks))]
         for b in p.blocks:
             b._sync_ops()

@@ -1,0 +1,1015 @@
+"""Program inspector: on-device tensor-stat probes, NaN/Inf origin
+attribution, gradient-flow audit, and a crash flight recorder.
+
+The reference framework's numeric-health story is all-or-nothing:
+FLAGS_check_nan_inf scans every op output on the host after each kernel
+(reference executor.cc:325-333 CheckTensorNANOrInf), and jax_debug_nans
+de-optimizes the whole program to op-by-op execution. This module keeps the
+whole-block jit while still localizing *which op and which step* went
+non-finite:
+
+1. Probe pass — `instrument(program, ...)` clones the program and inserts
+   `tensor_stats` ops after selected ops. Each probe reduces one tensor to an
+   8-float vector (min/max/mean/abs-mean/l2/nan-count/inf-count/size) *inside
+   the jitted computation*; the executor fetches the vectors alongside the
+   user's fetch list, so a probed step costs one device round-trip, not an
+   op-by-op fallback. Selection is by output name, op type, regex, explicit
+   indices, `every=True`, or `auto=True` (role boundaries + loss/grad vars).
+
+2. Origin attribution — `attribute_nonfinite(...)` replays a failing step
+   against a scratch copy of the scope, bisecting over program position:
+   each round probes one checkpoint op and halves the window, then a dense
+   pass over the final window names the first offending op; one more run
+   collects its inputs' stats. O(log n) replays, reported as a structured
+   `errors.NonFiniteError` + `nonfinite_detections_total` counter.
+
+3. Gradient-flow audit — `GradientAudit(program)` walks backward.py's
+   grad-var mapping and probes every trainable parameter's final gradient;
+   `report()` classifies each as zero / vanishing / exploding / nonfinite /
+   ok and feeds the telemetry gauges `grad_l2` / `grad_abs_mean`.
+
+4. Flight recorder — `enable_flight_recorder(path)` (or the
+   PADDLE_TPU_FLIGHT_RECORDER flag) keeps a bounded ring of recent step
+   records and dumps a JSON crash report (steps, probe stats, telemetry
+   events, flags/env, pprint_program text) on executor exception or fatal
+   signal. `read_crash_report` / `python -m paddle_tpu inspect <dump>`
+   read it back.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import signal as signal_mod
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import flags, telemetry
+from .errors import NonFiniteError
+from .framework.desc import VarType
+from .framework.framework import Program, grad_var_name
+from .ops import registry
+
+__all__ = [
+    "STAT_FIELDS", "TensorStats", "ProbeSite", "Attribution", "GradientAudit",
+    "instrument", "select_ops", "probe_compatible", "attribute_nonfinite",
+    "enable_flight_recorder", "disable_flight_recorder", "flight_enabled",
+    "dump_crash_report", "read_crash_report", "format_crash_report",
+    "probe_report", "feed_signature",
+]
+
+STAT_FIELDS = ("min", "max", "mean", "abs_mean", "l2",
+               "nan_count", "inf_count", "size")
+
+_FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+
+# op types whose outputs are not plain tensors a stats reduction can consume:
+# step scopes, rank tables, tensor arrays, or nothing at all (side-effect
+# ops). tensor_stats itself is excluded so `every=True` never probes probes.
+_NON_TENSOR_OUTPUT_OPS = frozenset({
+    "feed", "fetch", "while", "while_grad", "conditional_block",
+    "conditional_block_grad", "rnn", "write_to_array", "lod_rank_table",
+    "lod_tensor_to_array", "save", "save_combine", "tensor_stats",
+})
+
+
+# ---------------------------------------------------------------------------
+# The tensor_stats op
+# ---------------------------------------------------------------------------
+
+def _tensor_stats_infer(op, block):
+    for name in op.desc.outputs.get("Out", []):
+        if block.desc.has_var(name):
+            v = block.desc.var(name)
+            v.shape = [len(STAT_FIELDS)]
+            v.dtype = "float32"
+
+
+def _tensor_stats_lower(ctx, op_, ins):
+    """[min, max, mean, abs_mean, l2, nan_count, inf_count, size] of X as a
+    float32 vector. min/max/mean/l2 are computed over the *finite* elements
+    (masked), so the summary stays informative even while NaNs are present;
+    the counts carry the contamination. A 1-D [8] output stays below the
+    executor's ndim>=2 SEQLEN-inheritance rule, so probing a sequence tensor
+    never tags the stats vector as a sequence."""
+    k = len(STAT_FIELDS)
+    x = ins["X"][0] if ins.get("X") else None
+    if x is None:
+        return {"Out": [jnp.zeros((k,), jnp.float32)]}
+    x = jnp.asarray(x)
+    if x.size == 0:
+        return {"Out": [jnp.zeros((k,), jnp.float32)]}
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        nan_mask = jnp.isnan(x.real) | jnp.isnan(x.imag)
+        inf_mask = jnp.isinf(x.real) | jnp.isinf(x.imag)
+        xf = jnp.abs(x).astype(jnp.float32)
+    elif jnp.issubdtype(x.dtype, jnp.inexact):
+        # masks on the original dtype: a float64 value that overflows the
+        # float32 display cast must not be miscounted as Inf
+        nan_mask = jnp.isnan(x)
+        inf_mask = jnp.isinf(x)
+        xf = x.astype(jnp.float32)
+    else:
+        nan_mask = jnp.zeros(x.shape, bool)
+        inf_mask = jnp.zeros(x.shape, bool)
+        xf = x.astype(jnp.float32)
+    finite = ~(nan_mask | inf_mask)
+    n_finite = finite.sum().astype(jnp.float32)
+    denom = jnp.maximum(n_finite, 1.0)
+    safe = jnp.where(finite, xf, 0.0)
+    mn = jnp.where(n_finite > 0, jnp.where(finite, xf, jnp.inf).min(), 0.0)
+    mx = jnp.where(n_finite > 0, jnp.where(finite, xf, -jnp.inf).max(), 0.0)
+    out = jnp.stack([
+        mn, mx, safe.sum() / denom, jnp.abs(safe).sum() / denom,
+        jnp.sqrt(jnp.square(safe).sum()),
+        nan_mask.sum().astype(jnp.float32),
+        inf_mask.sum().astype(jnp.float32),
+        jnp.asarray(x.size, jnp.float32)])
+    return {"Out": [out]}
+
+
+if registry.try_get("tensor_stats") is None:
+    registry.register("tensor_stats", lower=_tensor_stats_lower,
+                      infer_shape=_tensor_stats_infer, grad=registry.NO_GRAD,
+                      non_diff_inputs=("X",))
+
+
+class TensorStats:
+    """Wrapper over one fetched stats vector."""
+
+    __slots__ = ("vec",)
+
+    def __init__(self, vec):
+        self.vec = np.asarray(vec, np.float64).ravel()
+
+    def _f(self, name):
+        return float(self.vec[STAT_FIELDS.index(name)])
+
+    min = property(lambda s: s._f("min"))
+    max = property(lambda s: s._f("max"))
+    mean = property(lambda s: s._f("mean"))
+    abs_mean = property(lambda s: s._f("abs_mean"))
+    l2 = property(lambda s: s._f("l2"))
+    nan_count = property(lambda s: s._f("nan_count"))
+    inf_count = property(lambda s: s._f("inf_count"))
+    size = property(lambda s: s._f("size"))
+
+    @property
+    def nonfinite(self) -> bool:
+        return (self.nan_count + self.inf_count) > 0
+
+    def to_dict(self) -> Dict[str, float]:
+        return {f: float(self.vec[i]) for i, f in enumerate(STAT_FIELDS)}
+
+    def __repr__(self):
+        return (f"TensorStats(min={self.min:.4g}, max={self.max:.4g}, "
+                f"mean={self.mean:.4g}, l2={self.l2:.4g}, "
+                f"nan={self.nan_count:.0f}, inf={self.inf_count:.0f}, "
+                f"size={self.size:.0f})")
+
+
+class ProbeSite:
+    """One inserted probe: which op (pristine-program index) and which var it
+    watches, and the stat var carrying its vector. kind: 'probe' (output
+    probe), 'input' (attribution input probe), 'grad' (GradientAudit)."""
+
+    __slots__ = ("op_index", "op_type", "var", "stat_var", "kind", "param")
+
+    def __init__(self, op_index, op_type, var, stat_var, kind="probe",
+                 param=None):
+        self.op_index = op_index
+        self.op_type = op_type
+        self.var = var
+        self.stat_var = stat_var
+        self.kind = kind
+        self.param = param
+
+    def to_dict(self):
+        return {"op_index": self.op_index, "op_type": self.op_type,
+                "var": self.var, "kind": self.kind, "param": self.param}
+
+    def __repr__(self):
+        return (f"ProbeSite(op {self.op_index} '{self.op_type}' "
+                f"-> '{self.var}', kind={self.kind})")
+
+
+class _Plan:
+    __slots__ = ("insert_at", "var", "site")
+
+    def __init__(self, insert_at, var, site):
+        self.insert_at = insert_at
+        self.var = var
+        self.site = site
+
+
+# ---------------------------------------------------------------------------
+# Probe pass
+# ---------------------------------------------------------------------------
+
+def probe_compatible(op_type: str) -> bool:
+    """Type-level predicate: can tensor_stats consume this op's output?
+    True when the op has a kernel lowering and pure-tensor outputs (see
+    tools/op_coverage.py --probe-compat for the registry-wide report)."""
+    if op_type in _NON_TENSOR_OUTPUT_OPS:
+        return False
+    opdef = registry.try_get(op_type)
+    return (opdef is not None and not opdef.no_kernel
+            and opdef.lower is not None)
+
+
+def _probeable_var(block, name: str) -> bool:
+    if not name or not block.desc.has_var(name):
+        return False
+    v = block.desc.var(name)
+    if v.type not in (VarType.LOD_TENSOR, VarType.SELECTED_ROWS):
+        return False
+    return (v.dtype or "float32") in _FLOAT_DTYPES
+
+
+def _probe_target(block, op) -> Optional[str]:
+    """First float-tensor output of `op`, or None when the op is not
+    probe-able (structural op, int outputs, no declared tensor output)."""
+    if not probe_compatible(op.type):
+        return None
+    for name in op.output_arg_names:
+        if _probeable_var(block, name):
+            return name
+    return None
+
+
+def _auto_indices(program: Program) -> List[int]:
+    """`auto` selection: block boundaries (first/last op + the last op of
+    each op_role segment: forward->backward->optimize transitions) plus the
+    ops producing the loss (backward.py records program._loss_names) and
+    every parameter gradient."""
+    block = program.global_block()
+    n = len(block.ops)
+    if not n:
+        return []
+    sel = {0, n - 1}
+    roles = [op.desc.attrs.get("op_role") for op in block.ops]
+    for i in range(n - 1):
+        if roles[i] != roles[i + 1]:
+            sel.add(i)
+    interesting = set(getattr(program, "_loss_names", ()))
+    interesting.update(grad_var_name(p.name)
+                       for p in block.all_parameters())
+    for i, op in enumerate(block.ops):
+        if interesting & set(op.output_arg_names):
+            sel.add(i)
+    return sorted(sel)
+
+
+def select_ops(program: Program, *, names=None, types=None, regex=None,
+               indices=None, auto: bool = False,
+               every: bool = False) -> List[int]:
+    """Root-block op indices matched by any of the selectors: output var
+    `names`, op `types`, a `regex` over op type and output names, explicit
+    `indices`, `auto` boundaries, or `every` op."""
+    block = program.global_block()
+    sel = set(int(i) for i in (indices or ()))
+    name_set = set(names or ())
+    type_set = set(types or ())
+    pat = re.compile(regex) if regex else None
+    for i, op in enumerate(block.ops):
+        if op.type in type_set:
+            sel.add(i)
+        if name_set and name_set & set(op.output_arg_names):
+            sel.add(i)
+        if pat is not None and (pat.search(op.type) or
+                                any(pat.search(n)
+                                    for n in op.output_arg_names)):
+            sel.add(i)
+    if every:
+        sel.update(range(len(block.ops)))
+    if auto:
+        sel.update(_auto_indices(program))
+    return sorted(i for i in sel if 0 <= i < len(block.ops))
+
+
+def _apply_plans(base: Program, plans: List[_Plan]) -> Program:
+    """Clone `base` and insert one tensor_stats op per plan. Insertions run
+    highest-position-first so earlier insert positions stay valid; sites keep
+    their *pristine* op indices for attribution windows."""
+    inst = base.clone()
+    block = inst.global_block()
+    for plan in sorted(plans, key=lambda p: p.insert_at, reverse=True):
+        block.create_var(name=plan.site.stat_var,
+                         shape=[len(STAT_FIELDS)], dtype="float32")
+        block.insert_op(plan.insert_at, type="tensor_stats",
+                        inputs={"X": [plan.var]},
+                        outputs={"Out": [plan.site.stat_var]},
+                        attrs={"op_role": "probe"})
+    inst._probe_sites = sorted((p.site for p in plans),
+                               key=lambda s: (s.op_index, s.kind, s.var))
+    inst._probe_parent = base
+    return inst
+
+
+def instrument(program: Program, *, names=None, types=None, regex=None,
+               indices=None, auto: bool = False,
+               every: bool = False) -> Program:
+    """Probe pass: return a clone of `program` with tensor_stats probes on
+    the first float output of every selected op. The executor fetches the
+    probe vectors with the user fetch list (one round-trip), records them on
+    the program as `_last_probe_stats` (see probe_report), and raises a
+    structured NonFiniteError — with bisection attribution — when any probe
+    reports NaN/Inf."""
+    base = getattr(program, "_probe_parent", None) or program
+    selected = select_ops(base, names=names, types=types, regex=regex,
+                          indices=indices, auto=auto, every=every)
+    block = base.global_block()
+    plans = []
+    for i in selected:
+        var = _probe_target(block, block.ops[i])
+        if var is None:
+            continue
+        plans.append(_Plan(i + 1, var, ProbeSite(
+            i, block.ops[i].type, var, f"{var}@STATS@{i}", kind="probe")))
+    if not plans:
+        raise ValueError(
+            "no probe-compatible ops matched the selection (see "
+            "inspector.probe_compatible / tools/op_coverage.py "
+            "--probe-compat)")
+    return _apply_plans(base, plans)
+
+
+def probe_report(program: Program) -> List[Dict[str, Any]]:
+    """Last run's probe stats of an instrumented program, as dicts sorted by
+    op position (empty before the first run)."""
+    stats = getattr(program, "_last_probe_stats", None) or {}
+    return [dict(site.to_dict(), stats=st.to_dict())
+            for site, st in sorted(stats.items(),
+                                   key=lambda it: it[0].op_index)]
+
+
+def feed_signature(feed) -> Optional[Tuple]:
+    """telemetry.signature_of over a user feed dict (tolerates LoDTensor and
+    plain-list values)."""
+    try:
+        from .executor import LoDTensor
+        vals = {}
+        for k, v in (feed or {}).items():
+            if isinstance(v, LoDTensor):
+                v = v.array()
+            vals[k] = np.asarray(v)
+        return telemetry.signature_of(vals)
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf origin attribution
+# ---------------------------------------------------------------------------
+
+class Attribution:
+    """Result of a bisection replay: the first op whose output went
+    non-finite, with its stats, its inputs' stats, and the replay cost."""
+
+    def __init__(self, op_index, op_type, var, stats, input_stats, inputs,
+                 outputs, creation_site, runs, feed_signature):
+        self.op_index = op_index
+        self.op_type = op_type
+        self.var = var
+        self.stats = stats
+        self.input_stats = input_stats      # {input var: TensorStats}
+        self.inputs = inputs
+        self.outputs = outputs
+        self.creation_site = creation_site
+        self.runs = runs                    # replay executor runs used
+        self.feed_signature = feed_signature
+
+    def summary(self) -> str:
+        parts = [f"origin: op {self.op_index} '{self.op_type}' -> "
+                 f"'{self.var}' ({self.stats.nan_count:.0f} NaN, "
+                 f"{self.stats.inf_count:.0f} Inf) "
+                 f"[{self.runs} replay run(s)]"]
+        if self.creation_site:
+            parts.append(f"built at {self.creation_site}")
+        for n, st in self.input_stats.items():
+            parts.append(f"input '{n}': {st!r}")
+        return "; ".join(parts)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "op_index": self.op_index, "op_type": self.op_type,
+            "var": self.var, "stats": self.stats.to_dict(),
+            "input_stats": {n: st.to_dict()
+                            for n, st in self.input_stats.items()},
+            "inputs": list(self.inputs), "outputs": list(self.outputs),
+            "creation_site": self.creation_site, "runs": self.runs,
+            "feed_signature": ([list(s) for s in self.feed_signature]
+                               if self.feed_signature else None),
+        }
+
+    def __repr__(self):
+        return f"Attribution({self.summary()})"
+
+
+def _copy_value(v, fallback):
+    """Host copy of one scope value for the attribution scratch scope.
+    Copies decouple the replay from jit buffer donation two ways: the
+    original scope's buffers may already be donated (deleted) — then the
+    post-step `fallback` from new_state stands in — and each replay run
+    re-uploads from numpy, so replay N's donation never invalidates
+    replay N+1's input."""
+    from .executor import LoDTensor
+    if v is None:
+        return None
+    if isinstance(v, LoDTensor):
+        try:
+            return LoDTensor(np.array(v.array()),
+                             [list(l) for l in (v.lod or [])])
+        except Exception:
+            v = fallback
+            if v is None:
+                return None
+    try:
+        return np.array(v)
+    except Exception:
+        if fallback is not None:
+            try:
+                return np.array(fallback)
+            except Exception:
+                return fallback
+        return v
+
+
+def _scratch_scope(scope, state):
+    from .executor import Scope
+    s = Scope()
+    seen = set()
+    sc = scope
+    while sc is not None:
+        for n, v in sc.vars.items():
+            if n in seen or n == "__rng_counter__":
+                continue
+            seen.add(n)
+            s.set_var(n, _copy_value(v, (state or {}).get(n)))
+        sc = sc.parent
+    return s
+
+
+def attribute_nonfinite(exe, program: Program, feed, *, scope=None,
+                        state=None, rng_counter=0, use_jit=None,
+                        window: Optional[Tuple[int, int]] = None,
+                        max_dense: int = 8,
+                        max_runs: int = 40) -> Optional[Attribution]:
+    """Name the first op whose output goes non-finite when `program` is
+    re-run with `feed`. Replays happen against a scratch copy of `scope`
+    (post-step persistable values from `state` stand in for donated
+    buffers), with the same rng_counter so dropout masks etc. reproduce.
+
+    Bisection over program position: each round instruments ONE checkpoint
+    op (midpoint of the window) and runs once; a finite checkpoint moves the
+    window past it, a non-finite one pulls the window in. Once the window is
+    <= max_dense candidate ops, one dense pass probes all of them, and a
+    final run collects the offender's input stats. Cost: ceil(log2(n /
+    max_dense)) + 2 replay runs — the acceptance bound is O(log n). Should
+    the non-finite value *not* propagate to a probed checkpoint (masked
+    downstream), a full dense fallback pass recovers correctness at the
+    price of one more run.
+
+    Returns None when attribution is inconclusive (nothing non-finite on
+    replay — e.g. nondeterministic corruption — or no probe-able ops)."""
+    if scope is None:
+        from .executor import global_scope
+        scope = global_scope()
+    base = getattr(program, "_probe_parent", None)
+    if base is None:
+        base = None if getattr(program, "_probe_sites", None) else program
+    if base is None:
+        return None
+    block = base.global_block()
+    cands = [i for i in range(len(block.ops))
+             if _probe_target(block, block.ops[i]) is not None]
+    if window is not None:
+        lo_op, hi_op = window
+        in_window = [i for i in cands if lo_op <= i <= hi_op]
+        cands = in_window or cands
+    if not cands:
+        return None
+
+    scratch = _scratch_scope(scope, state)
+    runs = 0
+
+    def probe_run(plans):
+        nonlocal runs
+        inst = _apply_plans(base, plans)
+        inst._inspector_internal = True
+        scratch.set_var("__rng_counter__", int(rng_counter))
+        vals = exe.run(inst, feed=dict(feed or {}),
+                       fetch_list=[s.stat_var for s in inst._probe_sites],
+                       scope=scratch, use_program_cache=False,
+                       use_jit=use_jit)
+        runs += 1
+        return [(site, TensorStats(v))
+                for site, v in zip(inst._probe_sites, vals)]
+
+    def out_plan(i):
+        var = _probe_target(block, block.ops[i])
+        return _Plan(i + 1, var, ProbeSite(
+            i, block.ops[i].type, var, f"{var}@STATS@{i}", kind="probe"))
+
+    try:
+        lo, hi = 0, len(cands) - 1
+        while (hi - lo + 1) > max_dense and runs < max_runs:
+            mid = (lo + hi) // 2
+            res = probe_run([out_plan(cands[mid])])
+            if any(st.nonfinite for _, st in res):
+                hi = mid
+            else:
+                lo = mid + 1
+        offender = offender_stats = None
+        res = probe_run([out_plan(cands[j]) for j in range(lo, hi + 1)])
+        for site, st in res:
+            if st.nonfinite:
+                offender, offender_stats = site, st
+                break
+        if offender is None and (lo > 0 or hi < len(cands) - 1) \
+                and runs < max_runs:
+            # the monotonic-propagation assumption failed: dense fallback
+            res = probe_run([out_plan(j) for j in cands])
+            for site, st in res:
+                if st.nonfinite:
+                    offender, offender_stats = site, st
+                    break
+        if offender is None:
+            return None
+
+        op = block.ops[offender.op_index]
+        input_stats: Dict[str, TensorStats] = {}
+        in_plans = [
+            _Plan(offender.op_index, n, ProbeSite(
+                offender.op_index, op.type, n,
+                f"{n}@STATS@in{offender.op_index}", kind="input"))
+            for n in dict.fromkeys(op.input_arg_names)
+            if _probeable_var(block, n)]
+        if in_plans and runs < max_runs:
+            try:
+                for site, st in probe_run(in_plans):
+                    input_stats[site.var] = st
+            except Exception:
+                input_stats = {}
+    except Exception:
+        return None
+
+    return Attribution(
+        op_index=offender.op_index, op_type=op.type, var=offender.var,
+        stats=offender_stats, input_stats=input_stats,
+        inputs=list(op.input_arg_names), outputs=list(op.output_arg_names),
+        creation_site=getattr(op, "creation_site", None), runs=runs,
+        feed_signature=feed_signature(feed))
+
+
+# ---------------------------------------------------------------------------
+# Executor hooks (probe recording + NonFiniteError raising)
+# ---------------------------------------------------------------------------
+
+def record_probes(exe, program, scope, sites, stat_vals, *, feed, new_state,
+                  rng_counter, prog_label):
+    """Called by the executor after a probed run, before state writeback:
+    stores stats on the program, feeds the gradient-audit gauges, and — when
+    any output probe reports NaN/Inf — counts the detection, runs bisection
+    attribution inside the window the probes already narrowed, and raises a
+    structured NonFiniteError (so the diverged state is never committed)."""
+    stats: Dict[ProbeSite, TensorStats] = {}
+    for site, val in zip(sites, stat_vals):
+        try:
+            stats[site] = TensorStats(val)
+        except Exception:
+            continue
+    program._last_probe_stats = stats
+    audit = getattr(program, "_grad_audit", None)
+    if audit is not None:
+        audit._observe(stats, prog_label)
+    bad = sorted(((s, st) for s, st in stats.items()
+                  if s.kind == "probe" and st.nonfinite),
+                 key=lambda it: it[0].op_index)
+    if not bad:
+        return
+    telemetry.counter(
+        "nonfinite_detections_total",
+        "NaN/Inf values caught by check_nan_inf or inspector probes",
+        labels=("program", "source")).labels(
+            program=prog_label, source="probe").inc()
+    site, st = bad[0]
+    # the probes already bracket the origin: start the bisection window at
+    # the last finite probed op before the first bad one
+    window_lo = 0
+    for s2, st2 in stats.items():
+        if s2.kind == "probe" and s2.op_index < site.op_index \
+                and not st2.nonfinite:
+            window_lo = max(window_lo, s2.op_index + 1)
+    attribution = None
+    if flags.get("nonfinite_attribution"):
+        try:
+            attribution = attribute_nonfinite(
+                exe, program, feed, scope=scope, state=new_state,
+                rng_counter=rng_counter, window=(window_lo, site.op_index))
+        except Exception:
+            attribution = None
+    msg = (f"NaN/Inf detected by probe: op {site.op_index} "
+           f"'{site.op_type}' output '{site.var}' has "
+           f"{st.nan_count:.0f} NaN / {st.inf_count:.0f} Inf values")
+    if attribution is not None:
+        msg += "\n  " + attribution.summary()
+    raise NonFiniteError(msg, var_name=site.var, op_type=site.op_type,
+                         op_index=site.op_index, stats=st,
+                         attribution=attribution,
+                         feed_signature=feed_signature(feed))
+
+
+# ---------------------------------------------------------------------------
+# Gradient-flow audit
+# ---------------------------------------------------------------------------
+
+class GradientAudit:
+    """Per-step gradient health for every trainable parameter.
+
+    Walks the program for the last op writing each parameter's grad var
+    (backward.py's grad_var_name mapping, after fan-in accumulation) and
+    probes it; `self.program` is the instrumented clone to run instead of
+    the original. A parameter with NO grad-producing op (detached from the
+    loss) is reported as status 'zero' without needing a probe. Each run
+    feeds telemetry: gauges grad_l2{program,param} / grad_abs_mean and
+    counter grad_audit_flags_total{program,param,status} for every non-ok
+    status. Non-finite gradients are *reported*, not raised — combine with
+    instrument()/check_nan_inf when divergence should abort the step."""
+
+    def __init__(self, program: Program, parameters=None,
+                 vanishing_threshold: float = 1e-8,
+                 exploding_threshold: float = 1e3):
+        base = getattr(program, "_probe_parent", None) or program
+        block = base.global_block()
+        if parameters is None:
+            params = [p.name for p in block.all_parameters()
+                      if getattr(p, "trainable", True)]
+        else:
+            params = [p if isinstance(p, str) else p.name
+                      for p in parameters]
+        self.params = params
+        self.vanishing_threshold = float(vanishing_threshold)
+        self.exploding_threshold = float(exploding_threshold)
+        self.missing: List[str] = []
+        plans = []
+        for pname in params:
+            g = grad_var_name(pname)
+            last = None
+            for i, op in enumerate(block.ops):
+                if g in op.output_arg_names:
+                    last = i
+            if last is None or not _probeable_var(block, g):
+                self.missing.append(pname)
+                continue
+            plans.append(_Plan(last + 1, g, ProbeSite(
+                last, block.ops[last].type, g, f"{g}@STATS@{last}",
+                kind="grad", param=pname)))
+        self.program = _apply_plans(base, plans) if plans else base.clone()
+        self.program._grad_audit = self
+        self._last: Dict[str, Dict[str, Any]] = {}
+
+    def classify(self, st: TensorStats) -> str:
+        if st.nonfinite:
+            return "nonfinite"
+        if st.l2 == 0.0:
+            return "zero"
+        if st.abs_mean < self.vanishing_threshold:
+            return "vanishing"
+        if max(abs(st.min), abs(st.max)) > self.exploding_threshold:
+            return "exploding"
+        return "ok"
+
+    def _observe(self, stats: Dict[ProbeSite, TensorStats], prog_label: str):
+        for site, st in stats.items():
+            if site.kind != "grad" or site.param is None:
+                continue
+            status = self.classify(st)
+            self._last[site.param] = dict(st.to_dict(), status=status,
+                                          grad_var=site.var)
+            telemetry.gauge(
+                "grad_l2", "per-parameter gradient L2 norm (GradientAudit)",
+                labels=("program", "param")).labels(
+                    program=prog_label, param=site.param).set(st.l2)
+            telemetry.gauge(
+                "grad_abs_mean",
+                "per-parameter gradient mean |g| (GradientAudit)",
+                labels=("program", "param")).labels(
+                    program=prog_label, param=site.param).set(st.abs_mean)
+            if status != "ok":
+                telemetry.counter(
+                    "grad_audit_flags_total",
+                    "gradient health flags (zero/vanishing/exploding/"
+                    "nonfinite) per parameter",
+                    labels=("program", "param", "status")).labels(
+                        program=prog_label, param=site.param,
+                        status=status).inc()
+
+    def report(self) -> Dict[str, Dict[str, Any]]:
+        out = {}
+        for p in self.params:
+            if p in self._last:
+                out[p] = dict(self._last[p])
+            elif p in self.missing:
+                out[p] = {"status": "zero",
+                          "reason": "no op writes this parameter's grad "
+                                    "var (detached from the loss)"}
+            else:
+                out[p] = {"status": "unknown",
+                          "reason": "audit program not run yet"}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 256, path: Optional[str] = None):
+        self.capacity = capacity
+        self.path = path
+        self.enabled = False
+        self.explicitly_disabled = False
+        self.records: collections.deque = collections.deque(maxlen=capacity)
+        self._signals_installed: List[Tuple[int, Any]] = []
+
+
+_RECORDER = FlightRecorder()
+_IN_CRASH = False
+
+
+def enable_flight_recorder(path: Optional[str] = None, capacity: int = 256,
+                           signals: bool = False) -> FlightRecorder:
+    """Start recording step records into a bounded ring buffer; a JSON crash
+    report lands at `path` on executor exception (and, with signals=True, on
+    SIGTERM/SIGABRT — plus a non-fatal diagnostic dump on SIGUSR1). Also
+    reachable without code changes via PADDLE_TPU_FLIGHT_RECORDER=<path>."""
+    _RECORDER.capacity = int(capacity)
+    _RECORDER.records = collections.deque(maxlen=_RECORDER.capacity)
+    _RECORDER.path = path or _RECORDER.path or "paddle_tpu_crash.json"
+    _RECORDER.enabled = True
+    _RECORDER.explicitly_disabled = False
+    if signals:
+        _install_signal_handlers()
+    return _RECORDER
+
+
+def disable_flight_recorder():
+    _RECORDER.enabled = False
+    _RECORDER.explicitly_disabled = True
+    _remove_signal_handlers()
+
+
+def flight_enabled() -> bool:
+    """Live check consulted by the executor each run; lazily honors the
+    PADDLE_TPU_FLIGHT_RECORDER flag (so flags.set enables it at runtime)."""
+    if not _RECORDER.enabled and not _RECORDER.explicitly_disabled:
+        p = flags.get("flight_recorder")
+        if p:
+            enable_flight_recorder(p, signals=True)
+    return _RECORDER.enabled
+
+
+def record_step(program, prog_label: str, info: Dict[str, Any]):
+    """Append one step record to the ring (executor hook)."""
+    if not _RECORDER.enabled:
+        return
+    rec = {"ts": time.time(), "program": prog_label}
+    rec.update(info)
+    stats = getattr(program, "_last_probe_stats", None)
+    if stats:
+        rec["probes"] = len(stats)
+        nonfinite = [dict(s.to_dict(), nan=st.nan_count, inf=st.inf_count)
+                     for s, st in stats.items() if st.nonfinite]
+        if nonfinite:
+            rec["nonfinite_probes"] = nonfinite
+    _RECORDER.records.append(rec)
+
+
+def notify_crash(exe, program, exc) -> Optional[str]:
+    """Executor crash hook: write the crash report (when the recorder is
+    enabled) and return its path. EOFException is the reader drain-loop's
+    normal end-of-pass signal, not a crash."""
+    global _IN_CRASH
+    if not flight_enabled() or _IN_CRASH:
+        return None
+    if getattr(program, "_inspector_internal", False):
+        return None
+    try:
+        from .layers.io import EOFException
+        if isinstance(exc, EOFException):
+            return None
+    except Exception:
+        pass
+    _IN_CRASH = True
+    try:
+        telemetry.counter(
+            "inspector_crash_reports_total",
+            "crash reports written by the flight recorder").inc()
+        path = dump_crash_report(_RECORDER.path, error=exc, program=program,
+                                 kind="exception")
+        print(f"paddle_tpu inspector: crash report written to {path} "
+              f"(read with `python -m paddle_tpu inspect {path}`)",
+              file=sys.stderr)
+        return path
+    except Exception:
+        return None
+    finally:
+        _IN_CRASH = False
+
+
+def dump_crash_report(path: Optional[str] = None, *, error=None,
+                      program=None, kind: str = "crash") -> str:
+    """Write the flight-recorder JSON crash report. Format (version 1):
+    {format, version, kind, ts, host, error{type,message,...}, env (the
+    PADDLE_TPU_*/JAX_*/XLA_* vars), flags (full registry dump), steps (the
+    ring), events (telemetry ring incl. retrace causes), metrics (local
+    snapshot), program (pprint_program text), probe_stats, grad_audit}."""
+    report: Dict[str, Any] = {
+        "format": "paddle_tpu-crash-report", "version": 1, "kind": kind,
+        "ts": time.time(),
+        "host": int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0),
+        "error": None,
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith(("PADDLE_TPU_", "PADDLE_TRAINER", "JAX_",
+                                 "XLA_"))},
+        "flags": {n: v for n, (v, _h) in flags.dump().items()},
+        "steps": list(_RECORDER.records),
+        "events": telemetry.recent_events(200),
+        "metrics": telemetry.registry().local_snapshot(),
+        "program": None, "probe_stats": None, "grad_audit": None,
+    }
+    if error is not None:
+        if isinstance(error, NonFiniteError):
+            report["error"] = error.to_dict()
+        else:
+            report["error"] = {"type": type(error).__name__,
+                               "message": str(error)}
+    if program is not None:
+        from . import debugger
+        lines: List[str] = []
+        try:
+            debugger.pprint_program(program, print_fn=lines.append)
+        except Exception:
+            lines.append("<program dump failed>")
+        report["program"] = "\n".join(lines)
+        report["program_label"] = telemetry.program_label(program)
+        stats = getattr(program, "_last_probe_stats", None)
+        if stats:
+            report["probe_stats"] = [
+                dict(s.to_dict(), stats=st.to_dict())
+                for s, st in sorted(stats.items(),
+                                    key=lambda it: it[0].op_index)]
+        audit = getattr(program, "_grad_audit", None)
+        if audit is not None:
+            report["grad_audit"] = audit.report()
+    path = path or _RECORDER.path or "paddle_tpu_crash.json"
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    return path
+
+
+def read_crash_report(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        report = json.load(f)
+    if report.get("format") != "paddle_tpu-crash-report":
+        raise ValueError(f"{path} is not a paddle_tpu crash report")
+    return report
+
+
+def _signal_handler(signum, frame):
+    try:
+        name = signal_mod.Signals(signum).name
+    except ValueError:
+        name = str(signum)
+    try:
+        dump_crash_report(kind=f"signal:{name}")
+    except Exception:
+        pass
+    if signum == getattr(signal_mod, "SIGUSR1", None):
+        return  # diagnostic dump only; keep running
+    _remove_signal_handlers()
+    signal_mod.signal(signum, signal_mod.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+def _install_signal_handlers():
+    if _RECORDER._signals_installed:
+        return
+    for signum in (signal_mod.SIGTERM, signal_mod.SIGABRT,
+                   getattr(signal_mod, "SIGUSR1", None)):
+        if signum is None:
+            continue
+        try:
+            prev = signal_mod.signal(signum, _signal_handler)
+        except (ValueError, OSError):
+            continue  # not the main thread / unsupported platform
+        _RECORDER._signals_installed.append((signum, prev))
+
+
+def _remove_signal_handlers():
+    for signum, prev in _RECORDER._signals_installed:
+        try:
+            signal_mod.signal(signum, prev)
+        except (ValueError, OSError):
+            pass
+    _RECORDER._signals_installed = []
+
+
+# ---------------------------------------------------------------------------
+# Crash-report pretty printer (the `inspect` CLI)
+# ---------------------------------------------------------------------------
+
+def _fmt_stats_dict(d: Dict[str, Any]) -> str:
+    try:
+        return (f"min={d['min']:.4g} max={d['max']:.4g} "
+                f"mean={d['mean']:.4g} l2={d['l2']:.4g} "
+                f"nan={d['nan_count']:.0f} inf={d['inf_count']:.0f}")
+    except Exception:
+        return str(d)
+
+
+def format_crash_report(report: Dict[str, Any], *,
+                        show_program: bool = False) -> str:
+    lines: List[str] = []
+    ts = report.get("ts")
+    when = (time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+            if ts else "?")
+    lines.append(f"paddle_tpu crash report  kind={report.get('kind')}  "
+                 f"host={report.get('host')}  {when}")
+    if report.get("program_label"):
+        lines.append(f"program: {report['program_label']}")
+    err = report.get("error")
+    if err:
+        lines.append(f"error: {err.get('type')}: {err.get('message')}")
+        if err.get("var_name"):
+            lines.append(f"  variable: '{err['var_name']}'"
+                         + (f" (dtype {err['dtype']})"
+                            if err.get("dtype") else ""))
+        attr = err.get("attribution")
+        if attr:
+            lines.append(
+                f"  origin: op {attr.get('op_index')} "
+                f"'{attr.get('op_type')}' -> '{attr.get('var')}' "
+                f"[{attr.get('runs')} replay run(s)]"
+                + (f", built at {attr['creation_site']}"
+                   if attr.get("creation_site") else ""))
+            for n, st in (attr.get("input_stats") or {}).items():
+                lines.append(f"    input '{n}': {_fmt_stats_dict(st)}")
+    steps = report.get("steps") or []
+    lines.append(f"steps recorded: {len(steps)}"
+                 + (" (most recent last)" if steps else ""))
+    for rec in steps[-10:]:
+        extra = ""
+        if rec.get("global_norm") is not None:
+            extra += f" |g|={rec['global_norm']:.4g}"
+        if rec.get("nonfinite_probes"):
+            extra += f" NONFINITE x{len(rec['nonfinite_probes'])}"
+        lines.append(
+            f"  {rec.get('program')} mode={rec.get('mode')} "
+            f"cache={rec.get('cache')} "
+            f"t={rec.get('seconds', 0.0):.4f}s{extra}")
+    probes = report.get("probe_stats") or []
+    if probes:
+        lines.append(f"probe stats (last step, {len(probes)} sites):")
+        for p in probes:
+            lines.append(f"  op {p.get('op_index')} '{p.get('op_type')}' "
+                         f"{p.get('var')}: "
+                         f"{_fmt_stats_dict(p.get('stats') or {})}")
+    audit = report.get("grad_audit") or {}
+    if audit:
+        lines.append("gradient audit:")
+        for param, info in sorted(audit.items()):
+            detail = (f" l2={info['l2']:.4g}" if "l2" in info else
+                      f" ({info.get('reason', '')})")
+            lines.append(f"  {param}: {info.get('status')}{detail}")
+    events = report.get("events") or []
+    if events:
+        counts: Dict[str, int] = {}
+        for e in events:
+            counts[e.get("kind", "?")] = counts.get(e.get("kind", "?"), 0) + 1
+        lines.append("telemetry events: "
+                     + ", ".join(f"{k}={v}"
+                                 for k, v in sorted(counts.items())))
+    fl = report.get("flags") or {}
+    interesting = {k: v for k, v in fl.items()
+                   if k in ("eager", "check_nan_inf", "trap_fp", "vlog",
+                            "nonfinite_attribution", "flight_recorder")
+                   and v not in ("", 0, False)}
+    if interesting:
+        lines.append("flags: " + ", ".join(f"{k}={v}"
+                                           for k, v in sorted(
+                                               interesting.items())))
+    if show_program and report.get("program"):
+        lines.append("")
+        lines.append(report["program"])
+    return "\n".join(lines)

@@ -34,7 +34,7 @@ __all__ = [
     "snapshot", "prometheus_text", "log_event", "recent_events",
     "enable_step_log", "disable_step_log", "step_log_path", "read_step_log",
     "export_chrome_trace", "default_buckets", "reset", "program_label",
-    "jax_compile_seconds", "signature_of",
+    "jax_compile_seconds", "signature_of", "read_gauge",
 ]
 
 
@@ -247,6 +247,23 @@ def gauge(name: str, help: str = "", labels: Sequence[str] = ()):
 def histogram(name: str, help: str = "", labels: Sequence[str] = (),
               buckets: Optional[Sequence[float]] = None):
     return _REG.histogram(name, help, labels, buckets)
+
+
+def read_gauge(name: str, **labels) -> Optional[float]:
+    """Last value of one gauge series, or None when the family or the exact
+    label set does not exist yet. A read-only peek: unlike `.labels(...)` it
+    never creates the series, so observers (the inspector flight recorder
+    reading optimizer_global_norm) cannot pollute the registry with empty
+    children."""
+    with _REG._lock:
+        fam = _REG._families.get(name)
+        if fam is None or fam.kind != "gauge":
+            return None
+        if set(labels) != set(fam.labelnames):
+            return None
+        child = fam._children.get(
+            tuple(str(labels[k]) for k in fam.labelnames))
+        return None if child is None else child.value
 
 
 def _host_index() -> int:

@@ -58,9 +58,30 @@ def inventory():
     return 0
 
 
+def probe_compat():
+    """Report which registered op types the inspector's tensor-stat probe
+    pass can instrument (inspector.probe_compatible): structural and
+    no-kernel ops are excluded, everything else gets on-device stats."""
+    import paddle_tpu  # noqa: F401  (registers all ops)
+    from paddle_tpu import inspector
+    from paddle_tpu.ops import registry
+
+    registered = sorted(registry.registered_ops())
+    compat = [t for t in registered if inspector.probe_compatible(t)]
+    incompat = [t for t in registered if not inspector.probe_compatible(t)]
+    print(f"registered ops   : {len(registered)}")
+    print(f"probe-compatible : {len(compat)}")
+    print(f"not probeable    : {len(incompat)}")
+    for t in incompat:
+        print(f"  NOT-PROBEABLE {t}")
+    return 0
+
+
 def main(path):
     if path == "--inventory":
         return inventory()
+    if path == "--probe-compat":
+        return probe_compat()
     if not os.path.exists(path):
         print(f"no record file at {path} — run the suite with "
               f"PADDLE_TPU_RECORD_OPS={path} first (see module docstring)")
