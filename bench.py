@@ -316,6 +316,14 @@ def _emit(payload, errors=()):
             payload["mfu_nominal"] = mfu
             payload["mfu_vs_sustained"] = round(
                 mfu * PEAK_TFLOPS / probe["tflops"], 4)
+    try:  # memory alongside images/sec; must never kill the bench line
+        from paddle_tpu import memory as memory_mod
+        mem = memory_mod.bench_summary()
+        if mem:
+            payload.setdefault("peak_hbm_bytes", mem["peak_hbm_bytes"])
+            payload.setdefault("hbm_utilization", mem["hbm_utilization"])
+    except Exception:
+        pass
     print(json.dumps(payload))
     sys.stdout.flush()
 

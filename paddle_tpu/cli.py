@@ -297,6 +297,132 @@ def cmd_telemetry(args):
     return 0
 
 
+def cmd_memory(args):
+    """HBM observability console (memory.py): static per-program footprint
+    (Compiled.memory_analysis + the peak-liveness walk), live accounting
+    after a real step, donation audit, and the what-if headroom estimate
+    ("will batch B fit?") — on the built-in smoke programs, a --config
+    model, or a crash report's memory section."""
+    import json
+
+    from paddle_tpu import inspector, memory, telemetry
+
+    if args.report:
+        report = inspector.read_crash_report(args.report)
+        section = {"memory": report.get("memory"),
+                   "error": report.get("error")}
+        if args.json:
+            print(json.dumps(section, indent=2, sort_keys=True))
+        else:
+            print(inspector.format_crash_report(report))
+        return 0
+
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import executor as executor_mod
+
+    budget = int(args.budget_gb * (1 << 30)) if args.budget_gb else None
+    out = []
+
+    def probe(label, main, loss, feed_fn, data_fn):
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        entry = {"program": label, "batch": args.batch}
+        measure = lambda b: exe.static_memory_analysis(
+            main, feed=feed_fn(b), fetch_list=[loss])
+        rec = measure(args.batch)
+        entry["static"] = rec.to_dict()
+        if data_fn is not None:
+            run_b = min(args.batch, 8)
+            exe.run(main, feed=data_fn(run_b), fetch_list=[loss])
+            entry["live"] = memory.tracker().last
+        if args.what_if:
+            entry["what_if"] = memory.what_if(
+                measure, batches=(max(args.batch // 4, 1), args.batch),
+                budget_bytes=budget)
+        out.append(entry)
+
+    with executor_mod.scope_guard(executor_mod.Scope()):
+        if args.config:
+            import paddle_tpu.minibatch as minibatch
+            cfg = _load_config(args.config)
+            spec = cfg.build()
+            exe0 = fluid.Executor(fluid.TPUPlace(0))
+            exe0.run(spec["startup_program"])
+            feeder = _feeder(fluid, cfg, spec)
+            batched = minibatch.batch(cfg.train_reader,
+                                      batch_size=args.batch)
+            feed = feeder.feed(next(iter(batched())))
+            arrs = {n: np.asarray(v.array() if hasattr(v, "array") else v)
+                    for n, v in feed.items()}
+
+            def feed_fn(b):
+                import jax
+                return {n: jax.ShapeDtypeStruct((b,) + a.shape[1:], a.dtype)
+                        for n, a in arrs.items()}
+
+            probe(os.path.basename(args.config), spec["main_program"],
+                  spec["loss"], feed_fn, lambda b: feed)
+        else:
+            for name in args.smoke.split(","):
+                spec = memory.build_smoke(name.strip())
+                exe0 = fluid.Executor(fluid.TPUPlace(0))
+                exe0.run(spec["startup"])
+                probe(spec["label"], spec["main"], spec["loss"],
+                      spec["feed_fn"], spec["data_fn"])
+
+    if args.json:
+        print(json.dumps({"programs": out,
+                          "report": memory.memory_report()},
+                         indent=2, sort_keys=True, default=str))
+        return 0
+
+    fmt = memory._fmt_bytes
+    status = 0
+    for entry in out:
+        s = entry["static"]
+        print(f"== {entry['program']} (batch {entry['batch']}) ==")
+        print(f"static: args={fmt(s['argument_bytes'])} "
+              f"out={fmt(s['output_bytes'])} temp={fmt(s['temp_bytes'])} "
+              f"alias={fmt(s['alias_bytes'])} "
+              f"code={fmt(s['generated_code_bytes'])} "
+              f"total={fmt(s['total_bytes'])}")
+        if s.get("donated_bytes"):
+            print(f"donation: donated={fmt(s['donated_bytes'])} "
+                  f"aliased={fmt(s['alias_bytes'])} "
+                  f"lost={fmt(s['donation_lost_bytes'])}")
+        peak = s.get("peak") or {}
+        if peak:
+            print(f"liveness walk: peak={fmt(peak['peak_bytes'])} at "
+                  f"instruction {peak['peak_pos']}/{peak['n_instructions']}"
+                  f" ({peak['live_at_peak']} buffers live)")
+            for row in peak.get("top") or []:
+                print(f"  {fmt(row['bytes']):>12s}  {row['instruction']}"
+                      f"  <- {row['op']}")
+        live = entry.get("live")
+        if live:
+            print(f"live after 1 step: in_use={fmt(live['bytes_in_use'])} "
+                  f"peak={fmt(live['peak_bytes'])} "
+                  f"(source={live['source']})"
+                  + ("".join(f" {k}={fmt(v)}"
+                             for k, v in (live.get("classes") or {}).items())))
+        wi = entry.get("what_if")
+        if wi:
+            line = (f"what-if (budget {fmt(wi['budget_bytes'])}): "
+                    f"max_batch={wi['max_batch']}")
+            if "rel_err" in wi:
+                ok = wi["rel_err"] <= 0.15
+                status = status or (0 if ok else 1)
+                line += (f", validated at b={wi['validate_batch']}: "
+                         f"predicted={fmt(wi['predicted_bytes'])} "
+                         f"measured={fmt(wi['measured_bytes'])} "
+                         f"rel_err={wi['rel_err'] * 100:.1f}% "
+                         f"(within 15%: {'yes' if ok else 'NO'})")
+            print(line)
+    if args.prometheus:
+        print(telemetry.prometheus_text(), end="")
+    return status
+
+
 def cmd_inspect(args):
     """Read back a flight-recorder crash report (inspector.py): the JSON a
     crashed run leaves behind, rendered as the post-mortem a human wants —
@@ -385,6 +511,33 @@ def main(argv=None):
     p_ins.add_argument("--program", action="store_true",
                        help="include the recorded program dump")
     p_ins.set_defaults(fn=cmd_inspect)
+
+    p_mem = sub.add_parser(
+        "memory", help="HBM footprint: static analysis, live accounting, "
+                       "what-if headroom")
+    p_mem.add_argument("--smoke", default="fit_a_line,resnet",
+                       help="comma list of built-in smoke programs "
+                            "(fit_a_line, resnet)")
+    p_mem.add_argument("--config", default=None,
+                       help="measure a --config model instead of the smokes")
+    p_mem.add_argument("--batch", type=int, default=32,
+                       help="base batch size for the static analysis")
+    p_mem.add_argument("--what-if", action="store_true",
+                       help="fit the headroom model and predict the max "
+                            "batch under --budget-gb (exit 1 if the "
+                            "validated prediction is off by more than 15%%)")
+    p_mem.add_argument("--budget-gb", type=float, default=0,
+                       help="HBM budget in GiB for --what-if (default: "
+                            "device bytes_limit, else 16)")
+    p_mem.add_argument("--report", default=None,
+                       help="print the memory/OOM section of a crash report "
+                            "instead of measuring")
+    p_mem.add_argument("--json", action="store_true",
+                       help="emit JSON instead of the human summary")
+    p_mem.add_argument("--prometheus", action="store_true",
+                       help="append the Prometheus exposition (hbm_*/"
+                            "memory_* gauges) after the summary")
+    p_mem.set_defaults(fn=cmd_memory)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

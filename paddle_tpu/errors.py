@@ -7,7 +7,8 @@ reference paddle/utils/CustomStackTrace.h layer-stack dump)."""
 
 from __future__ import annotations
 
-__all__ = ["EnforceNotMet", "EOFException", "NonFiniteError", "NotFoundError"]
+__all__ = ["EnforceNotMet", "EOFException", "NonFiniteError", "NotFoundError",
+           "OOMError"]
 
 
 class EnforceNotMet(RuntimeError):
@@ -57,6 +58,51 @@ class NonFiniteError(FloatingPointError, RuntimeError):
                             if self.attribution is not None else None),
             "feed_signature": ([list(s) for s in self.feed_signature]
                                if self.feed_signature else None),
+        }
+
+
+class OOMError(MemoryError, RuntimeError):
+    """The device ran out of HBM (XLA RESOURCE_EXHAUSTED). jax surfaces
+    this as a bare XlaRuntimeError whose message names the failed
+    allocation but nothing about WHAT is occupying the chip; the executor
+    (memory.maybe_oom_error) replaces it with this structured error.
+    Subclasses MemoryError (the natural Python type) and RuntimeError (so
+    handlers catching the raw jax error's base type keep working); the
+    message retains the RESOURCE_EXHAUSTED marker for text-matching retry
+    loops.
+
+    Fields: `breakdown` maps byte classes (params/opt_state/feeds plus
+    device bytes_in_use/bytes_limit when memory_stats is available),
+    `top_buffers` lists the largest live arrays (named when they map back
+    to scope/feed vars), `donation_lost_bytes` counts donated state XLA
+    failed to alias in place, `analysis` is the block's static
+    memory.ProgramMemory view, and `suggestions` are concrete next steps
+    (donate, AMP, remat, what-if batch sizing)."""
+
+    def __init__(self, message, program=None, breakdown=None,
+                 top_buffers=None, donation_lost_bytes=0, analysis=None,
+                 suggestions=None, device=None):
+        super().__init__(message)
+        self.program = program
+        self.breakdown = dict(breakdown or {})
+        self.top_buffers = list(top_buffers or [])
+        self.donation_lost_bytes = donation_lost_bytes
+        self.analysis = analysis
+        self.suggestions = list(suggestions or [])
+        self.device = device
+
+    def to_dict(self):
+        """JSON-serializable view (flight-recorder crash reports)."""
+        return {
+            "type": type(self).__name__,
+            "message": str(self),
+            "program": self.program,
+            "breakdown": self.breakdown,
+            "top_buffers": self.top_buffers,
+            "donation_lost_bytes": self.donation_lost_bytes,
+            "analysis": self.analysis,
+            "suggestions": self.suggestions,
+            "device": self.device,
         }
 
 

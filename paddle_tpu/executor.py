@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import flags as flags_mod
+from . import memory as memory_mod
 from . import telemetry
 from .framework.desc import VarType
 from .framework.framework import Program, Variable, default_main_program
@@ -500,6 +501,63 @@ class Executor:
             inspector_mod.notify_crash(self, program, e)
             raise
 
+    def static_memory_analysis(self, program=None, feed=None,
+                               fetch_list=None, scope=None, top_k=8):
+        """Compile-only memory footprint of `program` under `feed`: the
+        block is traced and compiled exactly as run() would (same
+        donation, shardings and state gathering) but never executed, so
+        no step runs and no real buffers are allocated — feed values may
+        be jax.ShapeDtypeStructs, letting what-if probes ask about batch
+        sizes that could never fit in host or device memory. Returns the
+        memory.ProgramMemory record (also kept in memory.records())."""
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in list(fetch_list or [])]
+        feed_vals, lod_map = {}, {}
+        for name, val in dict(feed or {}).items():
+            if isinstance(val, LoDTensor):
+                lod_map[name] = val.lod
+                arr = np.asarray(val.array())
+                if val.lod:
+                    arr, lengths, inner = pack_to_padded(arr, val.lod)
+                    feed_vals[name + SEQLEN_SUFFIX] = lengths
+                    if inner is not None:
+                        feed_vals[name + SEQLEN2_SUFFIX] = inner
+                feed_vals[name] = arr
+            elif hasattr(val, "shape") and hasattr(val, "dtype"):
+                feed_vals[name] = val   # array or aval, never materialized
+            else:
+                feed_vals[name] = np.asarray(val)
+        state_names = self._external_inputs(program, set(feed_vals), scope)
+        missing = [n for n in state_names if scope.find_var(n) is None]
+        if missing:
+            raise RuntimeError(
+                f"Variables {missing} are read by the program but absent "
+                f"from the scope — run the startup program first.")
+        state_vals = {}
+        for n in state_names:
+            v = scope.find_var(n)
+            if isinstance(v, LoDTensor):
+                lod_map[n] = v.lod
+                arr = np.asarray(v.array())
+                if v.lod:
+                    arr, lengths, inner = pack_to_padded(arr, v.lod)
+                    state_vals[n + SEQLEN_SUFFIX] = lengths
+                    if inner is not None:
+                        state_vals[n + SEQLEN2_SUFFIX] = inner
+                v = arr
+            state_vals[n] = v
+        compiled = self._compile(
+            program, sorted(state_vals), sorted(feed_vals), fetch_names,
+            self._persistable_outputs(program), lod_map)
+        return memory_mod.analyze(
+            compiled.fn, feed_vals, state_vals,
+            scope.find_var("__rng_counter__") or 0,
+            program=telemetry.program_label(program),
+            place=f"{type(self.place).__name__}:{self.place.device_id}",
+            top_k=top_k)
+
     def _run_impl(self, program, feed, fetch_list, feed_var_name,
                   fetch_var_name, scope, return_numpy, use_program_cache,
                   use_jit):
@@ -633,15 +691,26 @@ class Executor:
             new_sig = sig not in compiled.seen_sigs
             compile_before = telemetry.jax_compile_seconds()
             run_t0 = time.perf_counter()
-            with jax.default_device(self.device):
-                with profiler_mod.record("executor_run(jit)"):
-                    fetch_vals, fetch_lens, new_state = compiled.fn(
-                        feed_vals, state_vals, np.uint32(rng_counter))
-                    if profiler_mod.is_active():
-                        # async dispatch returns futures; force execution
-                        # inside the timed scope so the event measures the
-                        # step, not the enqueue (only when profiling)
-                        jax.block_until_ready((fetch_vals, new_state))
+            try:
+                with jax.default_device(self.device):
+                    with profiler_mod.record("executor_run(jit)"):
+                        fetch_vals, fetch_lens, new_state = compiled.fn(
+                            feed_vals, state_vals, np.uint32(rng_counter))
+                        if profiler_mod.is_active():
+                            # async dispatch returns futures; force execution
+                            # inside the timed scope so the event measures the
+                            # step, not the enqueue (only when profiling)
+                            jax.block_until_ready((fetch_vals, new_state))
+            except Exception as e:
+                # OOM forensics: a raw RESOURCE_EXHAUSTED becomes a
+                # structured errors.OOMError (breakdown, top live buffers,
+                # donation losses, suggestions) before the crash-report
+                # hook in run() sees it
+                oom = memory_mod.maybe_oom_error(
+                    self, program, prog_label, e, feed_vals, state_vals)
+                if oom is not None:
+                    raise oom from e
+                raise
             run_dt = time.perf_counter() - run_t0
             # compile-vs-execute split: XLA's own backend_compile events
             # (jax.monitoring) accumulated across the call — catches the
@@ -666,6 +735,20 @@ class Executor:
                     "compile", program=prog_label, place=place_label,
                     cause=cause, seconds=compile_s,
                     signature=[list(s) for s in sig])
+                if cause == "first_compile" and not internal_run:
+                    # static memory analysis once per compiled block: an
+                    # extra AOT lower/compile from avals (the persistent
+                    # compilation cache absorbs the XLA work); advisory —
+                    # a failure must never fail the training step
+                    try:
+                        memory_mod.on_compile(
+                            self, compiled, program, prog_label, place_label,
+                            feed_vals, state_vals, np.uint32(rng_counter),
+                            signature=sig)
+                    except Exception as mem_e:
+                        telemetry.log_event(
+                            "memory_analysis_error", program=prog_label,
+                            error=f"{type(mem_e).__name__}: {mem_e}")
                 if cause == "signature_change":
                     last = compiled.last_sig or ()
                     telemetry.counter(
@@ -709,9 +792,16 @@ class Executor:
             rng_key = jax.random.fold_in(jax.random.key(seed), rng_counter)
             compile_before = telemetry.jax_compile_seconds()
             run_t0 = time.perf_counter()
-            fetch_vals, fetch_lens, new_state = self._run_eager(
-                program, feed_vals, state_vals, fetch_names, persist_out,
-                rng_key, lod_map, check_nan=check_nan)
+            try:
+                fetch_vals, fetch_lens, new_state = self._run_eager(
+                    program, feed_vals, state_vals, fetch_names, persist_out,
+                    rng_key, lod_map, check_nan=check_nan)
+            except Exception as e:
+                oom = memory_mod.maybe_oom_error(
+                    self, program, prog_label, e, feed_vals, state_vals)
+                if oom is not None:
+                    raise oom from e
+                raise
             run_dt = time.perf_counter() - run_t0
             compile_s = telemetry.jax_compile_seconds() - compile_before
             mode, donated, cache_status = "eager", 0, "n/a"
@@ -750,6 +840,17 @@ class Executor:
             seconds=run_dt, compile_s=compile_s,
             execute_s=max(run_dt - compile_s, 0.0), cache=cache_status,
             donated=donated, feeds=len(feed_vals), fetches=n_user_fetch)
+
+        hbm_sample = None
+        if not internal_run:
+            # live HBM accounting: one tracker sample per run (gauges +
+            # flight-recorder fields below); byte counts come from avals
+            # only, so the donated state arrays are safe to measure
+            try:
+                hbm_sample = memory_mod.on_run(
+                    self, program, prog_label, feed_vals, state_vals)
+            except Exception:
+                hbm_sample = None
 
         for n, v in new_state.items():
             if n.endswith(SEQLEN_SUFFIX) or n.endswith(SEQLEN2_SUFFIX):
@@ -791,6 +892,9 @@ class Executor:
                     "rng_counter": int(rng_counter),
                     "global_norm": telemetry.read_gauge(
                         "optimizer_global_norm", program=prog_label),
+                    "hbm_bytes_in_use": (hbm_sample or {}).get(
+                        "bytes_in_use"),
+                    "hbm_peak_bytes": (hbm_sample or {}).get("peak_bytes"),
                 })
         # Fetched sequence vars come back in the reference's packed layout
         # ([sum_len, ...] rows): numpy mode returns the packed array, LoDTensor

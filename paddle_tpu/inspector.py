@@ -844,9 +844,17 @@ def dump_crash_report(path: Optional[str] = None, *, error=None,
         "events": telemetry.recent_events(200),
         "metrics": telemetry.registry().local_snapshot(),
         "program": None, "probe_stats": None, "grad_audit": None,
+        "memory": None,
     }
+    try:
+        from . import memory as memory_mod
+        report["memory"] = memory_mod.crash_section()
+    except Exception:
+        pass
     if error is not None:
-        if isinstance(error, NonFiniteError):
+        if hasattr(error, "to_dict"):
+            # structured errors (NonFiniteError, OOMError) serialize their
+            # own forensic fields
             report["error"] = error.to_dict()
         else:
             report["error"] = {"type": type(error).__name__,
@@ -929,6 +937,11 @@ def _remove_signal_handlers():
 # Crash-report pretty printer (the `inspect` CLI)
 # ---------------------------------------------------------------------------
 
+def _fmt_hbm(n) -> str:
+    from . import memory as memory_mod
+    return memory_mod._fmt_bytes(n)
+
+
 def _fmt_stats_dict(d: Dict[str, Any]) -> str:
     try:
         return (f"min={d['min']:.4g} max={d['max']:.4g} "
@@ -965,6 +978,28 @@ def format_crash_report(report: Dict[str, Any], *,
                    if attr.get("creation_site") else ""))
             for n, st in (attr.get("input_stats") or {}).items():
                 lines.append(f"    input '{n}': {_fmt_stats_dict(st)}")
+        if err.get("breakdown"):
+            lines.append("  memory breakdown: " + ", ".join(
+                f"{k}={_fmt_hbm(v)}"
+                for k, v in sorted(err["breakdown"].items())))
+        for b in (err.get("top_buffers") or [])[:5]:
+            nm = f" '{b['name']}'" if b.get("name") else ""
+            lines.append(f"  live buffer{nm}: {_fmt_hbm(b.get('nbytes'))} "
+                         f"{b.get('dtype')}{b.get('shape')}")
+    mem = report.get("memory") or {}
+    if mem.get("tracker") or mem.get("programs"):
+        tr = mem.get("tracker") or {}
+        if tr:
+            lines.append(f"memory: in_use={_fmt_hbm(tr.get('bytes_in_use'))} "
+                         f"peak={_fmt_hbm(mem.get('peak_bytes'))} "
+                         f"source={tr.get('source')}")
+        for p in (mem.get("programs") or [])[-3:]:
+            lines.append(
+                f"  {p.get('program')}: "
+                f"total={_fmt_hbm(p.get('total_bytes'))} "
+                f"(args={_fmt_hbm(p.get('argument_bytes'))} "
+                f"temp={_fmt_hbm(p.get('temp_bytes'))} "
+                f"out={_fmt_hbm(p.get('output_bytes'))})")
     steps = report.get("steps") or []
     lines.append(f"steps recorded: {len(steps)}"
                  + (" (most recent last)" if steps else ""))

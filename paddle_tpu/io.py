@@ -47,6 +47,7 @@ def save_vars(executor: Executor, dirname: str, main_program: Optional[Program]
     os.makedirs(dirname, exist_ok=True)
     scope = global_scope()
     combine = {}
+    total_bytes = n_saved = 0
     for v in vars:
         val = scope.find_var(v.name)
         if val is None:
@@ -55,6 +56,8 @@ def save_vars(executor: Executor, dirname: str, main_program: Optional[Program]
         if isinstance(val, LoDTensor):
             lod, val = val.lod, val.array()
         arr = np.asarray(val)
+        total_bytes += arr.nbytes
+        n_saved += 1
         if save_file_name is None:
             _save_one(os.path.join(dirname, v.name), arr, lod)
         else:
@@ -63,6 +66,24 @@ def save_vars(executor: Executor, dirname: str, main_program: Optional[Program]
         with open(os.path.join(dirname, save_file_name), "wb") as f:
             pickle.dump({k: (np.asarray(a), l) for k, (a, l)
                          in combine.items()}, f)
+    _record_checkpoint("save", dirname, total_bytes, n_saved)
+
+
+def _record_checkpoint(op: str, dirname: str, nbytes: int, n_vars: int):
+    """Checkpoint size telemetry: one gauge series per direction plus a
+    step-event record, so bench/telemetry logs show how much state each
+    save/load moved (ISSUE: memory observability covers disk-bound state
+    too, not just HBM)."""
+    try:
+        from . import telemetry
+        telemetry.gauge(
+            "checkpoint_bytes",
+            "tensor payload bytes of the last save_vars/load_vars",
+            labels=("op",)).labels(op=op).set(nbytes)
+        telemetry.log_event(f"checkpoint_{op}", dirname=dirname,
+                            bytes=nbytes, vars=n_vars)
+    except Exception:
+        pass
 
 
 def _save_one(path: str, arr: np.ndarray, lod):
@@ -93,20 +114,27 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]
     scope = global_scope()
+    total_bytes = n_loaded = 0
     if load_file_name is not None:
         with open(os.path.join(dirname, load_file_name), "rb") as f:
             blob = pickle.load(f)
         for v in vars:
             if v.name in blob:
                 arr, lod = blob[v.name]
+                total_bytes += np.asarray(arr).nbytes
+                n_loaded += 1
                 scope.set_var(v.name, LoDTensor(arr, lod) if lod else arr)
+        _record_checkpoint("load", dirname, total_bytes, n_loaded)
         return
     for v in vars:
         path = os.path.join(dirname, v.name)
         if not os.path.exists(path):
             continue
         arr, lod = _load_one(path)
+        total_bytes += np.asarray(arr).nbytes
+        n_loaded += 1
         scope.set_var(v.name, LoDTensor(arr, lod) if lod else arr)
+    _record_checkpoint("load", dirname, total_bytes, n_loaded)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):

@@ -25,3 +25,49 @@ def shard_feed(program, name, spec):
         program._feed_shardings = {}
     program._feed_shardings[name] = tuple(spec)
     return program
+
+
+def per_shard_param_bytes(program, scope=None):
+    """Per-device parameter bytes under the program's mesh: a parameter
+    annotated in `_param_shardings` (shard_parameter/shard_fc_params/
+    shard_all_params_zero) occupies size/prod(sharded axis sizes) HBM per
+    device under GSPMD; everything else is fully replicated. Complements
+    `Executor.static_memory_analysis`, whose memory_analysis() of an SPMD
+    program is already per-shard (XLA partitions the module before buffer
+    assignment) — this splits the same number into replicated-vs-sharded
+    so sweeps (tools/scaling_bench) can see WHY the footprint scales.
+    Returns {devices, replicated_bytes, sharded_bytes_per_device,
+    per_device_bytes, params}."""
+    from .. import executor as executor_mod
+    from .. import memory as memory_mod
+
+    scope = scope if scope is not None else executor_mod.global_scope()
+    m = getattr(program, "_mesh", None)
+    axis_sizes = dict(m.shape) if m is not None else {}
+    n_dev = 1
+    for s in axis_sizes.values():
+        n_dev *= int(s)
+    specs = getattr(program, "_param_shardings", {}) or {}
+    replicated = sharded = 0
+    detail = {}
+    for p in program.global_block().all_parameters():
+        v = scope.find_var(p.name)
+        b = memory_mod.nbytes_of(v)
+        if not b:
+            continue
+        factor = 1
+        for ax in specs.get(p.name) or ():
+            if ax:
+                factor *= int(axis_sizes.get(ax, 1))
+        if factor > 1:
+            per_dev = -(-b // factor)   # ceil: XLA pads uneven shards
+            sharded += per_dev
+            detail[p.name] = {"bytes": b, "per_device": per_dev,
+                              "factor": factor}
+        else:
+            replicated += b
+            detail[p.name] = {"bytes": b, "per_device": b, "factor": 1}
+    return {"devices": n_dev, "replicated_bytes": int(replicated),
+            "sharded_bytes_per_device": int(sharded),
+            "per_device_bytes": int(replicated + sharded),
+            "params": detail}

@@ -34,7 +34,7 @@ __all__ = [
     "snapshot", "prometheus_text", "log_event", "recent_events",
     "enable_step_log", "disable_step_log", "step_log_path", "read_step_log",
     "export_chrome_trace", "default_buckets", "reset", "program_label",
-    "jax_compile_seconds", "signature_of", "read_gauge",
+    "jax_compile_seconds", "signature_of", "read_gauge", "read_series",
 ]
 
 
@@ -264,6 +264,23 @@ def read_gauge(name: str, **labels) -> Optional[float]:
         child = fam._children.get(
             tuple(str(labels[k]) for k in fam.labelnames))
         return None if child is None else child.value
+
+
+def read_series(name: str) -> Dict[str, float]:
+    """All series of one counter/gauge family as {label_key: value}
+    (label_key is the registry's serialized 'k=v,k=v' form; the unlabeled
+    series maps from ''). Same read-only contract as read_gauge: never
+    creates the family or any child. Empty when the family is absent or a
+    histogram. Used by the memory CLI/bench to fold per-device hbm_*
+    gauges without knowing the device labels in advance."""
+    with _REG._lock:
+        fam = _REG._families.get(name)
+        if fam is None or fam.kind == "histogram":
+            return {}
+        return {
+            ",".join(f"{k}={v}" for k, v in zip(fam.labelnames, key)):
+                child.value
+            for key, child in fam._children.items()}
 
 
 def _host_index() -> int:

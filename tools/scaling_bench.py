@@ -93,8 +93,18 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None):
                                return_numpy=False)
             final = float(np.asarray(out).ravel()[0])
             dt = time.perf_counter() - t0
+            peak_hbm = None
+            try:
+                # per-shard static footprint (memory_analysis of an SPMD
+                # program is post-partitioning) — the memory column of the
+                # memory/throughput trade-off this sweep exists to show
+                rec = exe.static_memory_analysis(
+                    main, feed=feed, fetch_list=[avg_cost])
+                peak_hbm = rec.total_bytes
+            except Exception:
+                pass
     assert np.isfinite(final)
-    return batch * steps / dt
+    return batch * steps / dt, peak_hbm
 
 
 def main(argv):
@@ -114,13 +124,14 @@ def main(argv):
             f"{len(jax.devices())} available devices")
     results = {}
     for n in sizes:
-        sps = measure(n)
+        sps, peak_hbm = measure(n)
         results[n] = sps
         base = results[min(results)]
         eff = sps / (base / min(results) * n)
         print(json.dumps({"devices": n,
                           "samples_per_sec": round(sps, 2),
-                          "scaling_efficiency": round(eff, 4)}),
+                          "scaling_efficiency": round(eff, 4),
+                          "peak_hbm_bytes": peak_hbm}),
               flush=True)
     if len(results) > 1:
         top = max(results)
