@@ -27,6 +27,16 @@ BENCH_MODE selects the config family:
                      'sp' mesh of all visible devices; vs the r4 1.58 s/step
                      regression anchor
 
+`--steps-per-call K` (or BENCH_STEPS_PER_CALL) drives the CNN families
+through Executor.run_steps — K device steps per Python dispatch via one
+lax.scan window — and every JSON line carries `steps_per_call` plus a
+`python_overhead_per_step_ms` probe so the dispatch-overhead win is
+measurable against the K=1 baseline. TPU-hosts only for conv families:
+XLA:CPU compiles GRADIENT convolutions inside loop bodies with the naive
+expander instead of the Eigen path (~60x, measured: a conv train step in
+a scan runs 28s vs 0.47s for 8 top-level steps), so on a CPU host the
+knob only shows its win on conv-free configs.
+
 Resilience (VERDICT r4 #1): every mode retries transient tunnel/compile
 failures (bounded, BENCH_RETRIES), keeps completed timing chunks, and the
 top level ALWAYS prints the JSON line — on persistent failure with
@@ -51,6 +61,11 @@ STEPS = int(os.environ.get("BENCH_STEPS", "20"))
 # executable slow (program caching); warm past that to measure steady state
 WARMUP = int(os.environ.get("BENCH_WARMUP", "25"))
 AMP = os.environ.get("BENCH_AMP", "1") == "1"
+# fused multi-step loop (Executor.run_steps): K device steps per Python
+# dispatch. `--steps-per-call K` on the command line or the env var; 1 =
+# the classic per-step path. Every JSON line reports the value so BENCH_r*
+# capture the dispatch-overhead trend.
+STEPS_PER_CALL = int(os.environ.get("BENCH_STEPS_PER_CALL", "1"))
 AMP_LEVEL = os.environ.get("BENCH_AMP_LEVEL", "O2")
 # per-chip bf16 peak for MFU reporting (v5e ~197 TF/s, v4 ~275, v5p ~459);
 # override with BENCH_PEAK_TFLOPS for other chips. The in-session
@@ -89,6 +104,18 @@ CNN = {
 INFER_BS = 16  # the reference's §4 inference batch
 
 
+def _make_batch(batch, shapes_dtypes, rng):
+    out = {}
+    for name, shape, dtype in shapes_dtypes:
+        if dtype == "img":
+            out[name] = rng.standard_normal((batch,) + shape,
+                                            dtype=np.float32)
+        else:
+            out[name] = rng.integers(0, dtype, (batch,) + shape,
+                                     ).astype(np.int32)
+    return out
+
+
 def _feeds(exe, batch, shapes_dtypes, rng):
     """Rotating pre-staged HBM batches through the DoubleBufferedFeeder
     (reader/pipeline.py; reference create_double_buffer_reader_op.cc).
@@ -105,15 +132,7 @@ def _feeds(exe, batch, shapes_dtypes, rng):
     n_bufs = 3 if host_uploads else 2
 
     def make_batch():
-        out = {}
-        for name, shape, dtype in shapes_dtypes:
-            if dtype == "img":
-                out[name] = rng.standard_normal((batch,) + shape,
-                                                dtype=np.float32)
-            else:
-                out[name] = rng.integers(0, dtype, (batch,) + shape,
-                                         ).astype(np.int32)
-        return out
+        return _make_batch(batch, shapes_dtypes, rng)
 
     host = [make_batch() for _ in range(n_bufs)]
     if not host_uploads:
@@ -128,6 +147,64 @@ def _feeds(exe, batch, shapes_dtypes, rng):
 
     return iter(DoubleBufferedFeeder(
         reader, device=exe.device if host_uploads else None, capacity=1))
+
+
+def _windows(exe, batch, shapes_dtypes, rng, k):
+    """[K, B, ...] stacked windows for Executor.run_steps. Pre-staged in
+    HBM and rotated by default (same tunnel rationale as _feeds);
+    BENCH_HOST_PIPELINE=1 instead pulls each window through
+    DoubleBufferedFeeder.next_window — per-batch host conversion overlapped
+    with device compute, ONE stacked device_put per window."""
+    import jax
+    from paddle_tpu.reader.pipeline import DoubleBufferedFeeder
+
+    if os.environ.get("BENCH_HOST_PIPELINE", "0") == "1":
+        def reader():
+            while True:
+                yield _make_batch(batch, shapes_dtypes, rng)
+
+        feeder = DoubleBufferedFeeder(reader, device=None, capacity=2)
+
+        def gen():
+            while True:
+                yield feeder.next_window(k, device=exe.device)
+        return gen()
+
+    windows = []
+    for _ in range(2):
+        batches = [_make_batch(batch, shapes_dtypes, rng) for _ in range(k)]
+        windows.append({
+            name: jax.device_put(np.stack([b[name] for b in batches]),
+                                 exe.device)
+            for name, _, _ in shapes_dtypes})
+
+    def gen():
+        i = 0
+        while True:
+            yield windows[i % len(windows)]
+            i += 1
+    return gen()
+
+
+def _dispatch_overhead_ms(run_step, k, n=10):
+    """Host-side Python cost of driving ONE device step: time n
+    enqueue-only calls (no host sync between them — async dispatch means
+    the host returns as soon as the work is queued) and divide by the n*k
+    device steps they drive. This is the number run_steps exists to
+    shrink: the same model at --steps-per-call 8 should read ~8x lower.
+    Never allowed to kill the bench line."""
+    try:
+        out = run_step()
+        float(np.asarray(out).ravel()[0])            # drain the pipeline
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = run_step()
+        dt = time.perf_counter() - t0
+        float(np.asarray(out).ravel()[0])            # leave it drained
+        return round(dt / (n * k) * 1e3, 4)
+    except Exception as e:  # noqa: BLE001 - metric is best-effort
+        sys.stderr.write(f"dispatch-overhead probe failed: {e}\n")
+        return None
 
 
 _TRANSIENT_MARKERS = (
@@ -302,6 +379,7 @@ def _emit(payload, errors=()):
     """Print the ONE JSON line the driver parses. Attaches the retry error
     log and the session roofline (sustained TF/s + MFU against it) so a
     partial or degraded run is visible but still parseable."""
+    payload.setdefault("steps_per_call", STEPS_PER_CALL)
     allerr = _CARRIED_ERRORS + list(errors)
     if allerr:
         payload["errors"] = allerr
@@ -369,15 +447,33 @@ def main_cnn(family, train=True):
     shapes = [("img", (3, side, side), "img")]
     if train:
         shapes.append(("label", (1,), classes))  # infer programs take no label
-    feeds = _feeds(exe, batch, shapes, rng)
+    k = STEPS_PER_CALL
+    if k > 1:
+        windows = _windows(exe, batch, shapes, rng, k)
 
-    def step():
-        out, = exe.run(main_prog, feed=next(feeds), fetch_list=[fetch],
-                       return_numpy=False)
-        return out
+        def step():
+            out, = exe.run_steps(main_prog, feed_window=next(windows),
+                                 steps=k, fetch_list=[fetch],
+                                 fetch_mode="last", return_numpy=False)
+            return out
+
+        # STEPS/WARMUP stay denominated in device steps; the loop counts
+        # CALLS, each driving k steps through one lax.scan dispatch
+        calls, warm = max(1, STEPS // k), max(1, -(-WARMUP // k))
+    else:
+        feeds = _feeds(exe, batch, shapes, rng)
+
+        def step():
+            out, = exe.run(main_prog, feed=next(feeds), fetch_list=[fetch],
+                           return_numpy=False)
+            return out
+
+        calls, warm = STEPS, WARMUP
 
     errors = []
-    dt, done = _timed_loop(step, WARMUP, STEPS, errors)
+    dt, done = _timed_loop(step, warm, calls, errors)
+    done *= k
+    overhead_ms = _dispatch_overhead_ms(step, k)
     img_s = batch * done / dt
     flops_per_img = (3 if train else 1) * cfg["fwd_flops"]
     mfu = img_s * flops_per_img / (PEAK_TFLOPS * 1e12)
@@ -392,6 +488,7 @@ def main_cnn(family, train=True):
         "amp": AMP if train else False,
         "amp_level": (AMP_LEVEL if AMP else None) if train else None,
         "steps_timed": done,
+        "python_overhead_per_step_ms": overhead_ms,
         "mfu": round(mfu, 4),
     }, errors)
 
@@ -730,4 +827,7 @@ def main():
 
 
 if __name__ == "__main__":
+    args = sys.argv[1:]
+    if "--steps-per-call" in args:
+        STEPS_PER_CALL = int(args[args.index("--steps-per-call") + 1])
     sys.exit(main())

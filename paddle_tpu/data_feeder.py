@@ -98,3 +98,23 @@ class DataFeeder:
             "feed_conversion_seconds",
             "per-batch feed conversion latency").observe(dt)
         return ret_dict
+
+    def feed_window(self, minibatches):
+        """Convert K minibatches and stack each feed name into ONE [K, ...]
+        array — the host-side shape Executor.run_steps scans over. Dense
+        feeds only: LoD feeds pad per-batch (pack_to_padded) and would need
+        a per-step host repack, which is exactly what the fused window
+        avoids — feed those per-step via run_steps(feed_window=[...])
+        so the executor can fall back."""
+        dicts = [self.feed(mb) for mb in minibatches]
+        if not dicts:
+            raise ValueError("feed_window needs at least one minibatch")
+        window = {}
+        for name in self.feed_names:
+            vals = [d[name] for d in dicts]
+            if any(isinstance(v, LoDTensor) and v.lod for v in vals):
+                raise ValueError(
+                    f"feed '{name}' carries LoD; window stacking requires "
+                    f"dense batches (use per-step feeds instead)")
+            window[name] = np.stack([np.asarray(v) for v in vals])
+        return window

@@ -19,6 +19,7 @@ in the global scope across runs; block-local temporaries vanish after the run.
 from __future__ import annotations
 
 import contextlib
+import functools
 import os
 import sys
 import time
@@ -457,6 +458,23 @@ def _hlo_supplier(fn, feed_vals, state_vals, rng_counter):
     return supply
 
 
+@jax.jit
+def _finite_all(leaves):
+    """ONE fused finiteness reduction over every checked tensor of a step:
+    the jit-path check_nan_inf used to `np.asarray` each fetch and state
+    item — a device->host sync per tensor; this reduces them all on-device
+    and costs a single scalar readback. Trace-cached per aval signature."""
+    return functools.reduce(
+        jnp.logical_and, (jnp.all(jnp.isfinite(x)) for x in leaves),
+        jnp.asarray(True))
+
+
+class _WindowUnsupported(Exception):
+    """Raised at trace time when a program feature (sequence/LoD fetches,
+    shape-changing state) cannot ride through the lax.scan window; the
+    executor falls back to the per-step path."""
+
+
 class _CompiledBlock:
     def __init__(self, fn, state_names, feed_names, fetch_names, program):
         self.fn = fn
@@ -500,6 +518,330 @@ class Executor:
             from . import inspector as inspector_mod
             inspector_mod.notify_crash(self, program, e)
             raise
+
+    def run_steps(self, program: Optional[Program] = None, feed_window=None,
+                  *, reader=None, steps: Optional[int] = None,
+                  fetch_list: Optional[Sequence] = None,
+                  scope: Optional[Scope] = None, return_numpy: bool = True,
+                  fetch_mode: str = "last", use_program_cache: bool = True,
+                  use_jit: Optional[bool] = None):
+        """Run K training steps in ONE host dispatch: the per-step compiled
+        function wrapped in a jax.lax.scan over a device-stacked window of K
+        batches, with persistable state donated across the whole window.
+        One Python round-trip, one scope write-back, one telemetry record
+        per K steps — the fused-loop answer to the reference's
+        ParallelExecutor + double_buffer amortization
+        (operators/reader/create_double_buffer_reader_op.cc).
+
+        feed_window: a list of K per-step feed dicts, or a dict of arrays
+        pre-stacked with a leading [K] axis. reader: an object with
+        `next_window(k, device=...)` (reader.pipeline.DoubleBufferedFeeder)
+        pulled instead of feed_window; requires `steps`. fetch_mode: 'last'
+        (default) returns the final step's fetches, 'stack' a [K, ...] stack
+        per fetch, 'mean' the window mean (e.g. for loss curves).
+
+        Bitwise parity with K sequential run() calls is test-enforced
+        (tests/test_run_steps.py): the scan carries the same uint32 rng
+        counter the per-step path folds in, and `__rng_counter__` advances
+        atomically by K only after the window succeeds.
+
+        Falls back to K per-step run() calls — same results, per-step
+        dispatch cost — in eager mode, when check_nan_inf or inspector
+        probes need per-step attribution, and for LoD/sequence feeds or
+        state (the padded repack is per-batch host work). Telemetry
+        side-fetch gauges (_telemetry_fetch_extra) are skipped on the
+        window path: they are a per-step observability feature."""
+        program = program if program is not None else default_main_program()
+        try:
+            return self._run_steps_impl(
+                program, feed_window, reader, steps, fetch_list, scope,
+                return_numpy, fetch_mode, use_program_cache, use_jit)
+        except Exception as e:
+            from . import inspector as inspector_mod
+            inspector_mod.notify_crash(self, program, e)
+            raise
+
+    def _run_steps_impl(self, program, feed_window, reader, steps,
+                        fetch_list, scope, return_numpy, fetch_mode,
+                        use_program_cache, use_jit):
+        if fetch_mode not in ("last", "stack", "mean"):
+            raise ValueError(f"fetch_mode must be last|stack|mean, "
+                             f"got {fetch_mode!r}")
+        scope = scope if scope is not None else global_scope()
+        if reader is not None:
+            if feed_window is not None:
+                raise ValueError("pass feed_window or reader, not both")
+            if steps is None:
+                raise ValueError("reader windows need an explicit steps=K")
+            # may raise StopIteration at end of pass — the drain signal
+            feed_window = reader.next_window(steps, device=self.device)
+        if feed_window is None:
+            raise ValueError("run_steps needs feed_window= or reader=")
+        stacked, per_step, steps, lod_reason = self._normalize_window(
+            feed_window, steps)
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+
+        prog_label = telemetry.program_label(program)
+        place_label = f"{type(self.place).__name__}:{self.place.device_id}"
+        jit_mode = (not _EAGER) if use_jit is None else use_jit
+        check_nan = _CHECK_NAN_INF or flags_mod.get("check_nan_inf")
+        reason = lod_reason
+        if steps == 1:
+            reason = reason or "single_step"
+        elif not jit_mode:
+            reason = reason or "eager"
+        elif check_nan:
+            reason = reason or "check_nan_inf"
+        elif getattr(program, "_probe_sites", None):
+            reason = reason or "probes"
+        if reason is None:
+            # state-side LoD rejection: packed sequence state needs a
+            # host-side repack per step
+            fed = set(stacked)
+            for n in self._external_inputs(program, fed, scope):
+                v = scope.find_var(n)
+                if isinstance(v, LoDTensor) and v.lod:
+                    reason = "lod_state"
+                    break
+        if reason is None:
+            try:
+                return self._run_steps_window(
+                    program, stacked, steps, fetch_list, scope, return_numpy,
+                    fetch_mode, use_program_cache, prog_label, place_label)
+            except _WindowUnsupported as e:
+                reason = "trace_unsupported"
+                vlog(1, f"run_steps window unsupported, falling back: {e}")
+        if steps > 1:
+            telemetry.counter(
+                "executor_window_fallback_total",
+                "run_steps calls served by the per-step path",
+                labels=("program", "reason")).labels(
+                    program=prog_label, reason=reason).inc()
+        if per_step is None:
+            per_step = [{n: v[i] for n, v in stacked.items()}
+                        for i in range(steps)]
+        return self._run_steps_fallback(
+            program, per_step, fetch_list, scope, return_numpy, fetch_mode,
+            use_program_cache, use_jit)
+
+    @staticmethod
+    def _normalize_window(feed_window, steps):
+        """-> (stacked feed dict or None-if-LoD, per-step feed list or None,
+        K, lod-fallback reason or None). A list of per-step feed dicts
+        stacks host-side; a pre-stacked dict (leading [K] axis on every
+        leaf, e.g. from DoubleBufferedFeeder.next_window) passes through."""
+        if isinstance(feed_window, dict):
+            if not feed_window:
+                raise ValueError("feed_window dict is empty")
+            ks = set()
+            for n, v in feed_window.items():
+                if isinstance(v, LoDTensor):
+                    raise ValueError(
+                        f"pre-stacked feed_window entry '{n}' is a "
+                        f"LoDTensor; pass a list of per-step feed dicts "
+                        f"so the executor can fall back per-step")
+                shape = getattr(v, "shape", None)
+                if not shape:
+                    raise ValueError(
+                        f"feed_window entry '{n}' has no leading steps "
+                        f"axis (shape {shape})")
+                ks.add(int(shape[0]))
+            if len(ks) != 1:
+                raise ValueError(
+                    f"feed_window leading dims disagree: {sorted(ks)}")
+            k = ks.pop()
+            if steps is not None and steps != k:
+                raise ValueError(
+                    f"steps={steps} but feed_window leading dim is {k}")
+            return dict(feed_window), None, k, None
+        per_step = list(feed_window)
+        if not per_step:
+            raise ValueError("feed_window list is empty")
+        if steps is not None and steps != len(per_step):
+            raise ValueError(
+                f"steps={steps} but feed_window has {len(per_step)} entries")
+        names = set(per_step[0])
+        if any(set(f) != names for f in per_step[1:]):
+            raise ValueError("per-step feed dicts must share the same keys")
+        if any(isinstance(f[n], LoDTensor) and f[n].lod
+               for f in per_step for n in names):
+            return None, per_step, len(per_step), "lod_feed"
+        stacked = {}
+        for n in sorted(names):
+            stacked[n] = np.stack([np.asarray(f[n]) for f in per_step])
+        return stacked, per_step, len(per_step), None
+
+    def _run_steps_fallback(self, program, per_step_feeds, fetch_list, scope,
+                            return_numpy, fetch_mode, use_program_cache,
+                            use_jit):
+        """Per-step path: K sequential run() calls — identical results to
+        the fused window, per-step dispatch cost. The rng counter advances
+        +1 per completed step (a mid-window failure keeps the completed
+        prefix, matching plain sequential training)."""
+        outs = []
+        for f in per_step_feeds:
+            vals = self.run(program, feed=f, fetch_list=fetch_list,
+                            scope=scope, return_numpy=return_numpy,
+                            use_program_cache=use_program_cache,
+                            use_jit=use_jit)
+            if fetch_mode == "last":
+                outs = vals
+            else:
+                outs.append(vals)
+        if fetch_mode == "last":
+            return outs
+        cols = list(zip(*outs)) if outs else []
+        if fetch_mode == "stack":
+            return [np.stack([np.asarray(v) for v in col]) for col in cols]
+        return [np.mean(np.stack([np.asarray(v) for v in col]), axis=0)
+                for col in cols]
+
+    def _run_steps_window(self, program, stacked, steps, fetch_list, scope,
+                          return_numpy, fetch_mode, use_program_cache,
+                          prog_label, place_label):
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in list(fetch_list or [])]
+        feed_vals = {n: (v if isinstance(v, jax.Array) else np.asarray(v))
+                     for n, v in stacked.items()}
+        state_names = self._external_inputs(program, set(feed_vals), scope)
+        persist_out = self._persistable_outputs(program)
+        missing = [n for n in state_names if scope.find_var(n) is None]
+        if missing:
+            raise RuntimeError(
+                f"Variables {missing} are read by the program but absent "
+                f"from the scope — run the startup program first.")
+        state_vals = {}
+        for n in state_names:
+            v = scope.find_var(n)
+            if isinstance(v, LoDTensor):
+                v = np.asarray(v.array())   # lod-carrying state fell back
+            state_vals[n] = v
+        rng_counter = scope.find_var("__rng_counter__") or 0
+
+        state_keys = sorted(state_vals)
+        key = (id(program), getattr(program, "_version", 0),
+               tuple(sorted(feed_vals)), tuple(fetch_names),
+               tuple(state_keys), self.place,
+               getattr(program, "_amp_dtype", None),
+               getattr(program, "_amp_level", "O1"),
+               program.random_seed, "window", steps, fetch_mode)
+        compiled = self._cache.get(key) if use_program_cache else None
+        if compiled is None:
+            compiled = self._compile_window(
+                program, state_keys, sorted(feed_vals), fetch_names,
+                persist_out, {}, steps, fetch_mode)
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        sig = telemetry.signature_of(feed_vals)
+        new_sig = sig not in compiled.seen_sigs
+        compile_before = telemetry.jax_compile_seconds()
+        run_t0 = time.perf_counter()
+        try:
+            with jax.default_device(self.device):
+                from . import profiler as profiler_mod
+                with profiler_mod.record("executor_run(window)"):
+                    fetch_vals, new_state = compiled.fn(
+                        feed_vals, state_vals, np.uint32(rng_counter))
+                    if profiler_mod.is_active():
+                        jax.block_until_ready((fetch_vals, new_state))
+        except _WindowUnsupported:
+            self._cache.pop(key, None)
+            raise
+        except TypeError as e:
+            if "carry" in str(e):
+                # lax.scan rejected the carry: the program changes a state
+                # aval across steps (shape/dtype drift) — per-step territory
+                self._cache.pop(key, None)
+                raise _WindowUnsupported(str(e)) from e
+            raise
+        except Exception as e:
+            oom = memory_mod.maybe_oom_error(
+                self, program, prog_label, e, feed_vals, state_vals)
+            if oom is not None:
+                raise oom from e
+            raise
+        run_dt = time.perf_counter() - run_t0
+        compile_s = telemetry.jax_compile_seconds() - compile_before
+        cache_status = "miss" if new_sig else "hit"
+        if new_sig:
+            cause = ("first_compile" if not compiled.seen_sigs
+                     else "signature_change")
+            compiled.seen_sigs.add(sig)
+            telemetry.counter(
+                "executor_compiles_total", "block traces/compiles",
+                labels=("program", "place")).labels(
+                    program=prog_label, place=place_label).inc()
+            telemetry.counter(
+                "executor_compile_seconds_total",
+                "XLA compile wall seconds spent inside Executor.run",
+                labels=("program", "place")).labels(
+                    program=prog_label, place=place_label).inc(compile_s)
+            telemetry.log_event(
+                "compile", program=prog_label, place=place_label,
+                cause=cause, seconds=compile_s, window_steps=steps,
+                signature=[list(s) for s in sig])
+        else:
+            telemetry.counter(
+                "executor_cache_hits_total",
+                "runs served by an already-traced signature",
+                labels=("program", "place")).labels(
+                    program=prog_label, place=place_label).inc()
+        compiled.last_sig = sig
+
+        # window succeeded: counter commit is atomic for all K steps
+        scope.set_var("__rng_counter__", rng_counter + steps)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+
+        telemetry.counter(
+            "executor_runs_total", "Executor.run calls",
+            labels=("program", "place", "mode")).labels(
+                program=prog_label, place=place_label, mode="window").inc()
+        telemetry.counter(
+            "executor_steps_total",
+            "training/eval steps executed (a run_steps window counts K)",
+            labels=("program", "place")).labels(
+                program=prog_label, place=place_label).inc(steps)
+        telemetry.histogram(
+            "executor_run_seconds",
+            "Executor.run wall seconds (dispatch-only unless profiling "
+            "forces device sync)", labels=("program", "mode")).labels(
+                program=prog_label, mode="window").observe(run_dt)
+        if self._analysis(program)[3]:
+            telemetry.counter(
+                "optimizer_steps_total",
+                "runs of programs carrying optimizer-role ops",
+                labels=("program",)).labels(program=prog_label).inc(steps)
+        telemetry.log_event(
+            "run_window", program=prog_label, place=place_label,
+            mode="window", steps=steps, seconds=run_dt,
+            per_step_seconds=run_dt / steps, compile_s=compile_s,
+            execute_s=max(run_dt - compile_s, 0.0), cache=cache_status,
+            donated=len(state_vals), feeds=len(feed_vals),
+            fetches=len(fetch_names))
+
+        hbm_sample = None
+        try:
+            hbm_sample = memory_mod.on_run(
+                self, program, prog_label, feed_vals, state_vals)
+        except Exception:
+            hbm_sample = None
+        from . import inspector as inspector_mod
+        if inspector_mod.flight_enabled():
+            # ONE flight-recorder entry per window, per-step seconds
+            # derived from the window wall clock
+            inspector_mod.record_step(program, prog_label, {
+                "place": place_label, "mode": "window", "steps": steps,
+                "seconds": run_dt, "per_step_seconds": run_dt / steps,
+                "compile_s": compile_s, "cache": cache_status,
+                "feeds": len(feed_vals), "fetches": len(fetch_names),
+                "rng_counter": int(rng_counter),
+                "hbm_bytes_in_use": (hbm_sample or {}).get("bytes_in_use"),
+                "hbm_peak_bytes": (hbm_sample or {}).get("peak_bytes"),
+            })
+        return [np.asarray(v) if return_numpy else v for v in fetch_vals]
 
     def static_memory_analysis(self, program=None, feed=None,
                                fetch_list=None, scope=None, top_k=8):
@@ -659,8 +1001,12 @@ class Executor:
                 v = arr
             state_vals[n] = v
 
+        # the per-step PRNG counter is read here but only committed back to
+        # the scope after the step SUCCEEDS (past the compiled call, the
+        # check_nan_inf scan and the probe checks): a raising run must not
+        # advance the counter, or an OOM/NonFinite retry would replay the
+        # failed step under a different key
         rng_counter = scope.find_var("__rng_counter__") or 0
-        scope.set_var("__rng_counter__", rng_counter + 1)
 
         state_keys = sorted(state_vals)  # incl. @SEQLEN side channels
         if jit_mode:
@@ -775,18 +1121,24 @@ class Executor:
                 # Probe stat vectors are exempt: their counts describe OTHER
                 # tensors (record_probes inspects them below), and a stats
                 # l2 that overflowed to inf must not masquerade as a hit.
+                # ONE fused on-device reduction + ONE host sync for the
+                # whole step (_finite_all); the per-tensor np.asarray walk
+                # only runs on the failure path, to name the culprit
                 probe_stat_names = ({s.stat_var for s in probe_sites}
                                     if probe_sites else ())
-                for name, val in list(zip(fetch_names, fetch_vals)) + \
-                        list(new_state.items()):
-                    if name in probe_stat_names:
-                        continue
-                    arr = np.asarray(val)
-                    if np.issubdtype(arr.dtype, np.floating) and \
-                            not np.isfinite(arr).all():
-                        self._raise_nonfinite(
-                            program, name, arr, feed, new_state,
-                            rng_counter, scope, prog_label)
+                checked = [
+                    (name, val) for name, val in
+                    list(zip(fetch_names, fetch_vals)) + list(new_state.items())
+                    if name not in probe_stat_names
+                    and jnp.issubdtype(getattr(val, "dtype", None)
+                                       or np.asarray(val).dtype, jnp.inexact)]
+                if checked and not bool(_finite_all([v for _, v in checked])):
+                    for name, val in checked:
+                        arr = np.asarray(val)
+                        if not np.isfinite(arr).all():
+                            self._raise_nonfinite(
+                                program, name, arr, feed, new_state,
+                                rng_counter, scope, prog_label)
         else:
             seed = program.random_seed or 12345
             rng_key = jax.random.fold_in(jax.random.key(seed), rng_counter)
@@ -821,10 +1173,19 @@ class Executor:
                 new_state=new_state, rng_counter=rng_counter,
                 prog_label=prog_label)
 
+        # the step is now known-good: commit the PRNG counter atomically
+        # with (just before) the state write-back below
+        scope.set_var("__rng_counter__", rng_counter + 1)
+
         telemetry.counter(
             "executor_runs_total", "Executor.run calls",
             labels=("program", "place", "mode")).labels(
                 program=prog_label, place=place_label, mode=mode).inc()
+        telemetry.counter(
+            "executor_steps_total",
+            "training/eval steps executed (a run_steps window counts K)",
+            labels=("program", "place")).labels(
+                program=prog_label, place=place_label).inc()
         telemetry.histogram(
             "executor_run_seconds",
             "Executor.run wall seconds (dispatch-only unless profiling "
@@ -1205,11 +1566,13 @@ class Executor:
                     break
         return fetch, fetch_lens, new_state
 
-    def _compile(self, program, state_names, feed_names, fetch_names,
-                 persist_out, lod_map) -> _CompiledBlock:
+    def _make_step_fn(self, program, fetch_names, persist_out, lod_map):
+        """The pure per-step function `fn(feed_vals, state_vals, rng_counter)
+        -> (fetch, lens, new_state)` both compile paths share: _compile jits
+        it directly; _compile_window wraps it in a lax.scan over a stacked
+        feed window."""
         mesh = getattr(program, "_mesh", None)
         param_specs = getattr(program, "_param_shardings", {})
-
         seed = program.random_seed or 12345
 
         def fn(feed_vals, state_vals, rng_counter):
@@ -1239,59 +1602,136 @@ class Executor:
                 new_state = pinned
             return fetch, lens, new_state
 
-        if mesh is not None:
-            # SPMD: feeds sharded along batch over the 'dp' axis, state
-            # (parameters/accumulators) replicated. XLA GSPMD inserts the
-            # gradient AllReduce over ICI — the TPU-native replacement for
-            # the reference's pserver/NCCL paths (SURVEY.md §2.5).
-            from jax.sharding import NamedSharding, PartitionSpec
-            repl = NamedSharding(mesh, PartitionSpec())
+        return fn
 
-            # per-parameter PartitionSpec annotations (tensor / ZeRO
-            # sharding, parallel/tensor_parallel.py); unannotated state is
-            # replicated and XLA GSPMD partitions the consumers
-            state_shardings = {}
-            for n in state_names:
-                spec = param_specs.get(n)
-                state_shardings[n] = repl if spec is None else \
-                    NamedSharding(mesh, PartitionSpec(*spec))
+    def _shardings(self, program, state_names, feed_names, *, window=False):
+        """SPMD in_shardings for the compiled step, or None off-mesh: feeds
+        sharded along batch over the 'dp' axis, state (parameters /
+        accumulators) replicated unless annotated. XLA GSPMD inserts the
+        gradient AllReduce over ICI — the TPU-native replacement for the
+        reference's pserver/NCCL paths (SURVEY.md §2.5). With window=True
+        each feed gains a leading steps axis, so its per-step spec shifts
+        right by one (the scan axis is never sharded)."""
+        mesh = getattr(program, "_mesh", None)
+        if mesh is None:
+            return None
+        param_specs = getattr(program, "_param_shardings", {})
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(mesh, PartitionSpec())
 
-            # Feed sharding rule: an explicit per-feed override
-            # (program._feed_shardings[name] = spec tuple, see
-            # parallel.shard_feed) wins; otherwise feeds batch-shard on
-            # the axis named 'dp' when the mesh has one, and replicate on
-            # meshes without a data axis (sp/ep/mp-only meshes must opt
-            # in via shard_feed). @SEQLEN sidecars are [batch] vectors
-            # and follow their base feed's batch (dim-0) axis.
-            feed_specs = getattr(program, "_feed_shardings", {})
-            dp_axis = "dp" if "dp" in mesh.axis_names else None
-            default = NamedSharding(mesh, PartitionSpec(dp_axis)) \
-                if dp_axis else repl
+        # per-parameter PartitionSpec annotations (tensor / ZeRO
+        # sharding, parallel/tensor_parallel.py); unannotated state is
+        # replicated and XLA GSPMD partitions the consumers
+        state_shardings = {}
+        for n in state_names:
+            spec = param_specs.get(n)
+            state_shardings[n] = repl if spec is None else \
+                NamedSharding(mesh, PartitionSpec(*spec))
 
-            def _feed_sharding(n):
-                if n.endswith(SEQLEN2_SUFFIX):
-                    base = n[: -len(SEQLEN2_SUFFIX)]
-                elif n.endswith(SEQLEN_SUFFIX):
-                    base = n[: -len(SEQLEN_SUFFIX)]
-                else:
-                    base = None
-                if base is not None:
-                    bspec = feed_specs.get(base)
-                    if bspec is not None:
-                        return NamedSharding(mesh, PartitionSpec(
-                            bspec[0] if bspec else None))
-                    return default
-                spec = feed_specs.get(n)
-                if spec is not None:
-                    return NamedSharding(mesh, PartitionSpec(*spec))
-                return default
+        # Feed sharding rule: an explicit per-feed override
+        # (program._feed_shardings[name] = spec tuple, see
+        # parallel.shard_feed) wins; otherwise feeds batch-shard on
+        # the axis named 'dp' when the mesh has one, and replicate on
+        # meshes without a data axis (sp/ep/mp-only meshes must opt
+        # in via shard_feed). @SEQLEN sidecars are [batch] vectors
+        # and follow their base feed's batch (dim-0) axis.
+        feed_specs = getattr(program, "_feed_shardings", {})
+        dp_axis = "dp" if "dp" in mesh.axis_names else None
+        default_spec = (dp_axis,) if dp_axis else ()
 
-            feed_shardings = {n: _feed_sharding(n) for n in feed_names}
+        def _feed_spec(n):
+            if n.endswith(SEQLEN2_SUFFIX):
+                base = n[: -len(SEQLEN2_SUFFIX)]
+            elif n.endswith(SEQLEN_SUFFIX):
+                base = n[: -len(SEQLEN_SUFFIX)]
+            else:
+                base = None
+            if base is not None:
+                bspec = feed_specs.get(base)
+                if bspec is not None:
+                    return (bspec[0] if bspec else None,)
+                return default_spec
+            spec = feed_specs.get(n)
+            if spec is not None:
+                return tuple(spec)
+            return default_spec
+
+        def _feed_sharding(n):
+            spec = _feed_spec(n)
+            if window:
+                spec = (None,) + spec
+            return NamedSharding(mesh, PartitionSpec(*spec))
+
+        feed_shardings = {n: _feed_sharding(n) for n in feed_names}
+        return feed_shardings, state_shardings, repl
+
+    def _compile(self, program, state_names, feed_names, fetch_names,
+                 persist_out, lod_map) -> _CompiledBlock:
+        fn = self._make_step_fn(program, fetch_names, persist_out, lod_map)
+        sh = self._shardings(program, state_names, feed_names)
+        if sh is not None:
+            feed_shardings, state_shardings, repl = sh
             jitted = jax.jit(
                 fn, donate_argnums=(1,),
                 in_shardings=(feed_shardings, state_shardings, repl))
         else:
             jitted = jax.jit(fn, donate_argnums=(1,))
+        return _CompiledBlock(jitted, state_names, feed_names, fetch_names,
+                              program)
+
+    def _compile_window(self, program, state_names, feed_names, fetch_names,
+                        persist_out, lod_map, steps, fetch_mode) \
+            -> _CompiledBlock:
+        """Compile a K-step fused window: the per-step fn wrapped in a
+        jax.lax.scan whose carry is (persistable state, rng counter) and
+        whose xs is a feed dict with a leading [K] axis. One Python
+        dispatch, one donation, one write-back per K steps; per-step rng
+        parity comes from carrying the same uint32 counter the per-step
+        path folds in (step i of the window uses counter+i, bitwise what K
+        sequential runs would use)."""
+        step_fn = self._make_step_fn(program, fetch_names, persist_out,
+                                     lod_map)
+
+        def fnK(window_feed, state_vals, rng_counter):
+            def body(carry, feed_slice):
+                state, counter = carry
+                fetch, lens, new_state = step_fn(feed_slice, state, counter)
+                if lens:
+                    raise _WindowUnsupported(
+                        f"sequence fetches {sorted(lens)} need per-batch "
+                        f"LoD reconstruction")
+                # persistables written by the step ride the carry; state
+                # that is read but never written flows through unchanged;
+                # written-but-never-read persistables (no feedback edge)
+                # leave as per-step outputs and the last slice wins —
+                # exactly K sequential runs' write-back order
+                carry_state = {n: new_state.get(n, state[n]) for n in state}
+                extras = {n: v for n, v in new_state.items()
+                          if n not in state}
+                return (carry_state, counter + jnp.uint32(1)), (fetch, extras)
+
+            init = (state_vals, jnp.uint32(rng_counter))
+            (final_state, _), (fetch_seq, extra_seq) = jax.lax.scan(
+                body, init, window_feed)
+            if fetch_mode == "stack":
+                fetch = list(fetch_seq)
+            elif fetch_mode == "mean":
+                fetch = [jnp.mean(f, axis=0) for f in fetch_seq]
+            else:  # "last"
+                fetch = [f[-1] for f in fetch_seq]
+            new_state = dict(final_state)
+            for n, v in extra_seq.items():
+                new_state[n] = v[-1]
+            return fetch, new_state
+
+        sh = self._shardings(program, state_names, feed_names, window=True)
+        if sh is not None:
+            feed_shardings, state_shardings, repl = sh
+            jitted = jax.jit(
+                fnK, donate_argnums=(1,),
+                in_shardings=(feed_shardings, state_shardings, repl))
+        else:
+            jitted = jax.jit(fnK, donate_argnums=(1,))
         return _CompiledBlock(jitted, state_names, feed_names, fetch_names,
                               program)
 

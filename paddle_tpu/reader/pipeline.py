@@ -46,6 +46,9 @@ class DoubleBufferedFeeder:
         self._thread: Optional[threading.Thread] = None
         self._queue: Optional[queue.Queue] = None
         self._stop = threading.Event()
+        # persistent consumer generator for next_window: windows pull from
+        # ONE pass rather than restarting the reader per window
+        self._consumer = None
 
     def _produce(self):
         try:
@@ -91,7 +94,52 @@ class DoubleBufferedFeeder:
             batches.inc()
             yield item
 
+    def next_window(self, k: int, device=None) -> Dict[str, Any]:
+        """Pull the next k batches and stack each feed name into ONE
+        [k, ...] array, `jax.device_put` to `device` — the input half of the
+        fused multi-step loop (Executor.run_steps). The producer thread
+        keeps staging batch k+1, k+2, ... into the bounded queue while the
+        device computes the PREVIOUS window, so the host-side stack +
+        host->HBM copy of window N+1 overlaps window N's compute.
+
+        For window mode construct the feeder with device=None and pass the
+        target device here: one stacked transfer beats k small ones, and
+        per-batch device_put in the producer would force the stack back
+        through the host. Raises StopIteration at end of pass; a short
+        remainder (< k batches, XLA would need a fresh window shape) is
+        dropped and counted in input_window_dropped_batches_total."""
+        from .. import telemetry
+        if self._consumer is None:
+            self._consumer = iter(self)
+        feeds: List[Dict[str, Any]] = []
+        try:
+            while len(feeds) < k:
+                feeds.append(next(self._consumer))
+        except StopIteration:
+            self._consumer = None
+            if feeds:
+                telemetry.counter(
+                    "input_window_dropped_batches_total",
+                    "end-of-pass remainder batches shorter than the "
+                    "window").inc(len(feeds))
+            raise StopIteration from None
+        names = set(feeds[0])
+        if any(set(f) != names for f in feeds[1:]):
+            raise ValueError("window batches must share the same feed names")
+        window = {n: np.stack([np.asarray(f[n]) for f in feeds])
+                  for n in sorted(names)}
+        if device is not None:
+            window = {n: jax.device_put(v, device)
+                      for n, v in window.items()}
+        telemetry.counter(
+            "input_windows_total",
+            "stacked k-step windows delivered by prefetch feeders").inc()
+        return window
+
     def reset(self):
+        # NOTE: does not touch _consumer — __iter__'s generator body calls
+        # reset() on its first next(), which runs AFTER next_window stored
+        # the generator; next_window clears it itself at end of pass
         self.stop()
         self._stop.clear()
         self._queue = queue.Queue(maxsize=self.capacity)

@@ -36,6 +36,74 @@ class TestDoubleBufferedFeeder:
             next(it)
 
 
+class TestNextWindow:
+    """next_window(k): the input half of the fused multi-step loop."""
+
+    def _feeder(self, n):
+        batches = [{"x": np.full((2, 3), i, np.float32),
+                    "y": np.full((2, 1), -i, np.int64)} for i in range(n)]
+        return DoubleBufferedFeeder(lambda: iter(batches))
+
+    def test_stacks_k_batches_in_order(self):
+        dbf = self._feeder(7)
+        w = dbf.next_window(3)
+        assert set(w) == {"x", "y"}
+        assert w["x"].shape == (3, 2, 3) and w["y"].shape == (3, 2, 1)
+        np.testing.assert_array_equal(w["x"][:, 0, 0], [0, 1, 2])
+        # consecutive windows continue the SAME pass, no batch skipped
+        w2 = dbf.next_window(3)
+        np.testing.assert_array_equal(w2["x"][:, 0, 0], [3, 4, 5])
+
+    def test_short_remainder_dropped_at_end_of_pass(self):
+        from paddle_tpu import telemetry
+        dbf = self._feeder(7)
+        dbf.next_window(3)
+        dbf.next_window(3)
+        before = sum(telemetry.read_series(
+            "input_window_dropped_batches_total").values())
+        with pytest.raises(StopIteration):
+            dbf.next_window(3)   # only batch 6 left: dropped, counted
+        dropped = sum(telemetry.read_series(
+            "input_window_dropped_batches_total").values()) - before
+        assert dropped == 1
+        # the feeder is reusable: a fresh pass starts from batch 0
+        w = dbf.next_window(3)
+        np.testing.assert_array_equal(w["x"][:, 0, 0], [0, 1, 2])
+
+    def test_mismatched_feed_names_rejected(self):
+        batches = [{"x": np.zeros((2, 3), np.float32)},
+                   {"y": np.zeros((2, 3), np.float32)}]
+        dbf = DoubleBufferedFeeder(lambda: iter(batches))
+        with pytest.raises(ValueError, match="same feed names"):
+            dbf.next_window(2)
+
+
+class TestFeedWindow:
+    def test_data_feeder_feed_window(self):
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            fluid.layers.data(name="x", shape=[3], dtype="float32")
+            fluid.layers.data(name="y", shape=[1], dtype="int64")
+            feeder = fluid.DataFeeder(["x", "y"], fluid.CPUPlace(),
+                                      program=prog)
+        mbs = [[(np.full(3, i, np.float32), [i]) for i in (0, 1)],
+               [(np.full(3, i, np.float32), [i]) for i in (2, 3)]]
+        w = feeder.feed_window(mbs)
+        assert w["x"].shape == (2, 2, 3) and w["y"].shape == (2, 2, 1)
+        np.testing.assert_array_equal(w["y"][:, :, 0], [[0, 1], [2, 3]])
+
+    def test_feed_window_rejects_lod(self):
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            fluid.layers.data(name="seq", shape=[1], dtype="int64",
+                              lod_level=1)
+            feeder = fluid.DataFeeder(["seq"], fluid.CPUPlace(),
+                                      program=prog)
+        mbs = [[([1, 2],), ([3],)]]
+        with pytest.raises(ValueError, match="LoD"):
+            feeder.feed_window(mbs)
+
+
 class TestRecordIOReaderPipeline:
     def _write_dataset(self, path, n=32):
         def samples():

@@ -1,0 +1,272 @@
+"""Fused multi-step loop (Executor.run_steps): parity with K sequential
+run() calls must be BITWISE — same compiled per-step body, same rng
+counter fold — plus fallback behavior (eager, LoD, check_nan_inf) and the
+rng-counter atomicity contract."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu import flags, telemetry
+from paddle_tpu.errors import NonFiniteError
+
+K = 4
+
+
+def _clone_scope(src):
+    """Deep-copy a scope so two executions start from identical state."""
+    dst = executor_mod.Scope()
+    for n, v in src.vars.items():
+        if isinstance(v, executor_mod.LoDTensor):
+            dst.set_var(n, executor_mod.LoDTensor(
+                np.array(v.array(), copy=True), [list(l) for l in v.lod]))
+        elif v is None or isinstance(v, (int, float)):
+            dst.set_var(n, v)
+        else:
+            dst.set_var(n, np.array(v, copy=True))
+    return dst
+
+
+def _scope_arrays(scope):
+    return {n: np.asarray(v.array())
+            if isinstance(v, executor_mod.LoDTensor) else np.asarray(v)
+            for n, v in scope.vars.items() if v is not None}
+
+
+def _assert_scope_parity(sa, sb):
+    a, b = _scope_arrays(sa), _scope_arrays(sb)
+    assert set(a) == set(b), f"state keys differ: {set(a) ^ set(b)}"
+    for n in a:
+        np.testing.assert_array_equal(
+            a[n], b[n], err_msg=f"state '{n}' diverged")
+
+
+def _run_parity(prog, startup, loss, feeds, *, use_jit=None,
+                expect_fallback_reason=None):
+    """Run K sequential steps and one run_steps window from identical
+    initial scopes; assert bitwise-equal losses and final state."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    sa = executor_mod.Scope()
+    exe.run(startup, scope=sa)
+    sb = _clone_scope(sa)
+    c0 = sa.find_var("__rng_counter__") or 0   # startup run advanced it
+
+    seq_losses = []
+    for f in feeds:
+        out, = exe.run(prog, feed=f, fetch_list=[loss], scope=sa,
+                       use_jit=use_jit)
+        seq_losses.append(np.asarray(out))
+
+    before = sum(telemetry.read_series(
+        "executor_window_fallback_total").values())
+    win_losses, = exe.run_steps(prog, feed_window=feeds, fetch_list=[loss],
+                                scope=sb, fetch_mode="stack",
+                                use_jit=use_jit)
+    fell_back = sum(telemetry.read_series(
+        "executor_window_fallback_total").values()) - before
+    if expect_fallback_reason is None:
+        assert fell_back == 0, "window path unexpectedly fell back"
+    else:
+        assert fell_back >= 1, \
+            f"expected fallback ({expect_fallback_reason}) did not happen"
+        series = telemetry.read_series("executor_window_fallback_total")
+        assert any(expect_fallback_reason in k for k in series), series
+
+    np.testing.assert_array_equal(np.stack(seq_losses),
+                                  np.asarray(win_losses))
+    # rng counter advanced identically (sequential: +1 per run; window: +K)
+    assert (sa.find_var("__rng_counter__") or 0) == \
+        (sb.find_var("__rng_counter__") or 0) == c0 + len(feeds)
+    _assert_scope_parity(sa, sb)
+    return exe, sa, sb
+
+
+def _fit_a_line(dropout=False):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        if dropout:
+            h = fluid.layers.dropout(h, dropout_prob=0.5)
+        y_predict = fluid.layers.fc(input=h, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(
+            avg_cost, startup_program=startup)
+    rng = np.random.default_rng(7)
+    w = rng.standard_normal((13, 1)).astype(np.float32)
+    feeds = []
+    for _ in range(K):
+        xs = rng.standard_normal((8, 13)).astype(np.float32)
+        feeds.append({"x": xs, "y": (xs @ w).astype(np.float32)})
+    return prog, startup, avg_cost, feeds
+
+
+def _conv_model():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data(name="img", shape=[1, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                   act="relu")
+        pool = fluid.layers.pool2d(conv, pool_size=2, pool_type="max",
+                                   pool_stride=2)
+        pred = fluid.layers.fc(input=pool, size=10, act="softmax")
+        cost = fluid.layers.cross_entropy(input=pred, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(
+            avg_cost, startup_program=startup)
+    rng = np.random.default_rng(3)
+    feeds = [{"img": rng.standard_normal((4, 1, 8, 8)).astype(np.float32),
+              "label": rng.integers(0, 10, (4, 1)).astype(np.int64)}
+             for _ in range(K)]
+    return prog, startup, avg_cost, feeds
+
+
+def _seq_model():
+    """Sequence (LoD) model: window stacking must reject the ragged feed
+    and fall back to the per-step path with identical results."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        words = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(input=words, size=[50, 8])
+        pooled = fluid.layers.sequence_pool(input=emb, pool_type="sum")
+        pred = fluid.layers.fc(input=pooled, size=2, act="softmax")
+        cost = fluid.layers.cross_entropy(input=pred, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(
+            avg_cost, startup_program=startup)
+    rng = np.random.default_rng(11)
+    feeds = []
+    for _ in range(K):
+        lens = [2, 3, 1]
+        offs = np.concatenate([[0], np.cumsum(lens)]).tolist()
+        flat = rng.integers(0, 50, (offs[-1], 1)).astype(np.int64)
+        feeds.append({
+            "words": executor_mod.LoDTensor(flat, [offs]),
+            "label": rng.integers(0, 2, (3, 1)).astype(np.int64)})
+    return prog, startup, avg_cost, feeds
+
+
+class TestRunStepsParity:
+    def test_fit_a_line_jit(self):
+        _run_parity(*_fit_a_line())
+
+    def test_conv_model_jit(self):
+        _run_parity(*_conv_model())
+
+    def test_dropout_rng_parity(self):
+        """The scan carries the same uint32 counter the per-step path folds
+        in: per-step dropout masks must be bitwise identical."""
+        _run_parity(*_fit_a_line(dropout=True))
+
+    def test_lod_feeds_fall_back(self):
+        _run_parity(*_seq_model(), expect_fallback_reason="lod_feed")
+
+    def test_eager_falls_back(self):
+        _run_parity(*_fit_a_line(), use_jit=False,
+                    expect_fallback_reason="eager")
+
+
+class TestRunStepsAPI:
+    def test_prestacked_dict_and_fetch_modes(self):
+        prog, startup, loss, feeds = _fit_a_line()
+        exe = fluid.Executor(fluid.CPUPlace())
+        sa = executor_mod.Scope()
+        exe.run(startup, scope=sa)
+        sb = _clone_scope(sa)
+        sc = _clone_scope(sa)
+
+        stacked = {n: np.stack([f[n] for f in feeds]) for n in feeds[0]}
+        all_losses, = exe.run_steps(prog, feed_window=feeds,
+                                    fetch_list=[loss], scope=sa,
+                                    fetch_mode="stack")
+        last, = exe.run_steps(prog, feed_window=stacked, fetch_list=[loss],
+                              scope=sb, fetch_mode="last")
+        mean, = exe.run_steps(prog, feed_window=stacked, steps=K,
+                              fetch_list=[loss], scope=sc, fetch_mode="mean")
+        np.testing.assert_array_equal(all_losses[-1], last)
+        np.testing.assert_allclose(np.asarray(all_losses).mean(axis=0),
+                                   mean, rtol=1e-6)
+        _assert_scope_parity(sa, sb)
+        _assert_scope_parity(sa, sc)
+
+    def test_window_shape_validation(self):
+        prog, startup, loss, feeds = _fit_a_line()
+        exe = fluid.Executor(fluid.CPUPlace())
+        s = executor_mod.Scope()
+        exe.run(startup, scope=s)
+        with pytest.raises(ValueError, match="steps=3"):
+            exe.run_steps(prog, feed_window=feeds, steps=3,
+                          fetch_list=[loss], scope=s)
+        bad = {n: np.stack([f[n] for f in feeds]) for n in feeds[0]}
+        bad["y"] = bad["y"][:2]
+        with pytest.raises(ValueError, match="leading dims"):
+            exe.run_steps(prog, feed_window=bad, fetch_list=[loss], scope=s)
+        with pytest.raises(ValueError, match="feed_window"):
+            exe.run_steps(prog, fetch_list=[loss], scope=s)
+
+    def test_steps_total_counts_k(self):
+        prog, startup, loss, feeds = _fit_a_line()
+        exe = fluid.Executor(fluid.CPUPlace())
+        s = executor_mod.Scope()
+        exe.run(startup, scope=s)
+        before = sum(telemetry.read_series("executor_steps_total").values())
+        exe.run_steps(prog, feed_window=feeds, fetch_list=[loss], scope=s)
+        after = sum(telemetry.read_series("executor_steps_total").values())
+        assert after - before == K
+
+
+class TestRngCounterAtomicity:
+    def test_failed_run_does_not_advance(self):
+        prog, startup, loss, feeds = _fit_a_line()
+        exe = fluid.Executor(fluid.CPUPlace())
+        s = executor_mod.Scope()
+        exe.run(startup, scope=s)
+        c0 = s.find_var("__rng_counter__") or 0
+        bad = dict(feeds[0])
+        bad["x"] = np.full_like(bad["x"], np.nan)
+        flags.set("check_nan_inf", True)
+        try:
+            with pytest.raises(NonFiniteError):
+                exe.run(prog, feed=bad, fetch_list=[loss], scope=s)
+        finally:
+            flags.set("check_nan_inf", None)
+        # the failed step must be replayable under the SAME key
+        assert (s.find_var("__rng_counter__") or 0) == c0
+        # state buffers were donated to the failed call; re-init (counter
+        # survives in the scope) and confirm a good step advances by one
+        exe.run(startup, scope=s)
+        c1 = s.find_var("__rng_counter__")
+        exe.run(prog, feed=feeds[0], fetch_list=[loss], scope=s)
+        assert s.find_var("__rng_counter__") == c1 + 1
+
+    def test_window_advances_atomically_by_k(self):
+        prog, startup, loss, feeds = _fit_a_line()
+        exe = fluid.Executor(fluid.CPUPlace())
+        s = executor_mod.Scope()
+        exe.run(startup, scope=s)
+        c0 = s.find_var("__rng_counter__") or 0
+        exe.run_steps(prog, feed_window=feeds, fetch_list=[loss], scope=s)
+        assert s.find_var("__rng_counter__") == c0 + K
+        exe.run_steps(prog, feed_window=feeds, fetch_list=[loss], scope=s)
+        assert s.find_var("__rng_counter__") == c0 + 2 * K
+
+    def test_fused_check_passes_finite_data(self):
+        """The fused finiteness reduction (one sync per step) must not
+        false-positive on a healthy step."""
+        prog, startup, loss, feeds = _fit_a_line()
+        exe = fluid.Executor(fluid.CPUPlace())
+        s = executor_mod.Scope()
+        exe.run(startup, scope=s)
+        flags.set("check_nan_inf", True)
+        try:
+            out, = exe.run(prog, feed=feeds[0], fetch_list=[loss], scope=s)
+        finally:
+            flags.set("check_nan_inf", None)
+        assert np.isfinite(np.asarray(out)).all()

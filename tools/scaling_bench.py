@@ -13,6 +13,14 @@ ICI AllReduce target directly:
 
     python tools/scaling_bench.py                 # all local devices
     python tools/scaling_bench.py 1 4 8           # specific mesh sizes
+    python tools/scaling_bench.py --steps-per-call 8 1 4 8
+                                  # fused K-step windows (Executor.run_steps)
+
+`--steps-per-call K` (or SCALE_STEPS_PER_CALL) drives each mesh size
+through Executor.run_steps — K steps per dispatch via one lax.scan window,
+state shardings riding the scan carry — so the sweep captures the
+dispatch-overhead trend next to the scaling trend; every per-mesh JSON
+line carries a `steps_per_call` column.
 
 On a CPU host it exercises the identical GSPMD path over virtual devices
 — mechanism check only; the shared core makes the timings say nothing
@@ -38,7 +46,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
 
-def measure(n_devices, steps=None, warmup=None, per_device_batch=None):
+def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
+            steps_per_call=None):
     # SCALE_BS/SCALE_STEPS shrink the config for mechanism checks on CPU
     # hosts (VGG jit compiles cost minutes per mesh size on 1-core boxes);
     # real-slice measurements should keep the reference bs128
@@ -48,8 +57,11 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None):
         warmup = int(os.environ.get("SCALE_WARMUP", "8"))
     if per_device_batch is None:
         per_device_batch = int(os.environ.get("SCALE_BS", "128"))
-    if steps < 1 or per_device_batch < 1:
-        raise SystemExit("SCALE_STEPS and SCALE_BS must be >= 1")
+    if steps_per_call is None:
+        steps_per_call = int(os.environ.get("SCALE_STEPS_PER_CALL", "1"))
+    if steps < 1 or per_device_batch < 1 or steps_per_call < 1:
+        raise SystemExit(
+            "SCALE_STEPS, SCALE_BS and SCALE_STEPS_PER_CALL must be >= 1")
     warmup = max(warmup, 1)   # the sync readback needs at least one run
     model_name = os.environ.get("SCALE_MODEL", "vgg16")
     import jax
@@ -80,19 +92,40 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None):
         rng = np.random.default_rng(0)
         x = rng.standard_normal((batch, 3, 32, 32), dtype=np.float32)
         y = rng.integers(0, 10, (batch, 1)).astype(np.int64)
+        k = steps_per_call
+        # per-step feed is always built: the k=1 path runs on it, and
+        # static_memory_analysis below reports the per-STEP footprint
         feed = {"img": jax.device_put(x), "label": jax.device_put(y)}
+        if k > 1:
+            # fused window: one [K, B, ...] feed, K steps per dispatch;
+            # the dp state shardings ride the scan carry
+            window = {"img": jax.device_put(np.stack([x] * k)),
+                      "label": jax.device_put(np.stack([y] * k))}
+
+            def run_one():
+                out, = exe.run_steps(main, feed_window=window, steps=k,
+                                     fetch_list=[avg_cost],
+                                     fetch_mode="last", return_numpy=False)
+                return out
+        else:
+            def run_one():
+                out, = exe.run(main, feed=feed, fetch_list=[avg_cost],
+                               return_numpy=False)
+                return out
+
+        warm_calls = max(1, -(-warmup // k))
+        calls = max(1, steps // k)
         with em.scope_guard(em.Scope()):
             exe.run(startup)
-            for _ in range(warmup):
-                out, = exe.run(main, feed=feed, fetch_list=[avg_cost],
-                               return_numpy=False)
+            for _ in range(warm_calls):
+                out = run_one()
             float(np.asarray(out).ravel()[0])
             t0 = time.perf_counter()
-            for _ in range(steps):
-                out, = exe.run(main, feed=feed, fetch_list=[avg_cost],
-                               return_numpy=False)
+            for _ in range(calls):
+                out = run_one()
             final = float(np.asarray(out).ravel()[0])
             dt = time.perf_counter() - t0
+            steps = calls * k   # actual device steps timed
             peak_hbm = None
             try:
                 # per-shard static footprint (memory_analysis of an SPMD
@@ -115,6 +148,17 @@ def main(argv):
     plat = os.environ.get("SCALE_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
+    argv = list(argv)
+    steps_per_call = None
+    if "--steps-per-call" in argv:
+        i = argv.index("--steps-per-call")
+        try:
+            steps_per_call = int(argv[i + 1])
+        except (IndexError, ValueError):
+            raise SystemExit("--steps-per-call needs an integer argument")
+        del argv[i:i + 2]
+    if steps_per_call is None:
+        steps_per_call = int(os.environ.get("SCALE_STEPS_PER_CALL", "1"))
     sizes = sorted({int(a) for a in argv}) or sorted(
         {1, 2, len(jax.devices())} & set(range(1, len(jax.devices()) + 1)))
     too_big = [s for s in sizes if s > len(jax.devices())]
@@ -124,13 +168,14 @@ def main(argv):
             f"{len(jax.devices())} available devices")
     results = {}
     for n in sizes:
-        sps, peak_hbm = measure(n)
+        sps, peak_hbm = measure(n, steps_per_call=steps_per_call)
         results[n] = sps
         base = results[min(results)]
         eff = sps / (base / min(results) * n)
         print(json.dumps({"devices": n,
                           "samples_per_sec": round(sps, 2),
                           "scaling_efficiency": round(eff, 4),
+                          "steps_per_call": steps_per_call,
                           "peak_hbm_bytes": peak_hbm}),
               flush=True)
     if len(results) > 1:
@@ -141,7 +186,7 @@ def main(argv):
         print(json.dumps({
             "metric": f"{model_name}_dp_scaling_efficiency",
             "value": round(eff, 4), "unit": "fraction",
-            "devices": top,
+            "devices": top, "steps_per_call": steps_per_call,
             "vs_baseline": round(eff / 0.6089, 3),  # ref 60.89% @ 100 tr
         }))
 
