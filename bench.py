@@ -374,6 +374,42 @@ def _roofline_cached():
 
 _CARRIED_ERRORS = []  # errors from a failed whole-family attempt (main())
 
+# step thunk of the family currently being measured; each main_* sets it so
+# _emit can attach per-op roofline attribution to the JSON line. Cleared
+# after every emit (a failed family must not reuse the previous one's step).
+_PERF_STEP = [None]
+
+
+def _perf_fields(probe=None):
+    """`top_ops` / `bound` / `device_duty_cycle` for the JSON line (ISSUE 6:
+    every bench line carries the evidence the MFU campaign needs): runs the
+    family's step 3 more times under a silent traced session and joins the
+    roofline report. BENCH_PERF=0 skips it; any failure degrades to no
+    extra fields — the bench line itself must never die here."""
+    step = _PERF_STEP[0]
+    if step is None or os.environ.get("BENCH_PERF", "1") != "1":
+        return {}
+    try:
+        from paddle_tpu import roofline
+
+        if probe:
+            # reuse the session's sustained-matmul measurement instead of
+            # probing twice (the ridge only needs the HBM probe on top)
+            roofline._PROBES.setdefault("sustained_tflops", probe["tflops"])
+        report = roofline.capture(step, steps=3)
+        if not report:
+            return {}
+        out = {"top_ops": roofline.top_ops(report),
+               "device_duty_cycle": report.get("device_duty_cycle")}
+        attributed = [r for r in report["rows"]
+                      if r["bound"] != "unattributed"]
+        out["bound"] = (attributed[0]["bound"] if attributed
+                        else "unattributed")
+        return out
+    except Exception as e:  # noqa: BLE001 - attribution is best-effort
+        sys.stderr.write(f"perf attribution failed: {e}\n")
+        return {}
+
 
 def _emit(payload, errors=()):
     """Print the ONE JSON line the driver parses. Attaches the retry error
@@ -402,6 +438,9 @@ def _emit(payload, errors=()):
             payload.setdefault("hbm_utilization", mem["hbm_utilization"])
     except Exception:
         pass
+    if payload.get("value") is not None:
+        payload.update(_perf_fields(probe))
+    _PERF_STEP[0] = None
     print(json.dumps(payload))
     sys.stdout.flush()
 
@@ -470,6 +509,7 @@ def main_cnn(family, train=True):
 
         calls, warm = STEPS, WARMUP
 
+    _PERF_STEP[0] = step
     errors = []
     dt, done = _timed_loop(step, warm, calls, errors)
     done *= k
@@ -489,6 +529,77 @@ def main_cnn(family, train=True):
         "amp_level": (AMP_LEVEL if AMP else None) if train else None,
         "steps_timed": done,
         "python_overhead_per_step_ms": overhead_ms,
+        "mfu": round(mfu, 4),
+    }, errors)
+
+
+def main_fc():
+    """Conv-free 3-layer MLP classifier (784-1024-1024-10, Momentum): the
+    portable attribution family. No convolutions means no XLA:CPU
+    grad-conv cliff inside scan bodies, so `--families fc` runs the full
+    timed-loop + roofline-attribution path on any host — the CI smoke for
+    the bench-side perf fields (ISSUE 6 acceptance)."""
+    import paddle_tpu as fluid
+
+    bsz = int(BATCH) if BATCH else 256
+    hid = int(os.environ.get("BENCH_FC_HIDDEN", "1024"))
+    classes = 10
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data(name="x", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=hid, act="relu")
+        h = fluid.layers.fc(input=h, size=hid, act="relu")
+        logits = fluid.layers.fc(input=h, size=classes, act="softmax")
+        cost = fluid.layers.cross_entropy(input=logits, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        opt = fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+        if AMP:
+            opt = fluid.amp.decorate(opt, level=AMP_LEVEL)
+        opt.minimize(avg_cost, startup_program=startup)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+
+    rng = np.random.default_rng(0)
+    shapes = [("x", (784,), "img"), ("label", (1,), classes)]
+    k = STEPS_PER_CALL
+    if k > 1:
+        windows = _windows(exe, bsz, shapes, rng, k)
+
+        def step():
+            out, = exe.run_steps(main_prog, feed_window=next(windows),
+                                 steps=k, fetch_list=[avg_cost],
+                                 fetch_mode="last", return_numpy=False)
+            return out
+
+        calls, warm = max(1, STEPS // k), max(1, -(-WARMUP // k))
+    else:
+        feeds = _feeds(exe, bsz, shapes, rng)
+
+        def step():
+            out, = exe.run(main_prog, feed=next(feeds),
+                           fetch_list=[avg_cost], return_numpy=False)
+            return out
+
+        calls, warm = STEPS, WARMUP
+
+    _PERF_STEP[0] = step
+    errors = []
+    dt, done = _timed_loop(step, warm, calls, errors)
+    done *= k
+    ex_s = bsz * done / dt
+    fwd_flops = 2 * (784 * hid + hid * hid + hid * classes)
+    mfu = 3 * ex_s * fwd_flops / (PEAK_TFLOPS * 1e12)
+    _emit({
+        "metric": "fc_mlp_train_examples_per_sec",
+        "value": round(ex_s, 1),
+        "unit": "examples/sec",
+        "vs_baseline": None,   # no reference-published MLP anchor
+        "batch": bsz, "hidden": hid, "amp": AMP,
+        "steps_timed": done,
+        "python_overhead_per_step_ms": _dispatch_overhead_ms(step, k),
         "mfu": round(mfu, 4),
     }, errors)
 
@@ -541,6 +652,7 @@ def main_lstm():
                         return_numpy=False)
         return loss
 
+    _PERF_STEP[0] = step
     errors = []
     dt, done = _timed_loop(step, warmup, steps, errors)
     ms_batch = dt / done * 1000
@@ -599,6 +711,10 @@ def main_attention():
 
     g_flash = make(lambda a, bb, c: flash_attention(a, bb, c, True))
     g_xla = make(lambda a, bb, c: attention_reference(a, bb, c, causal=True))
+    # raw-jax family: no executor suppliers, so attribution degrades to
+    # duty cycle + unattributed rows — still worth carrying on the line
+    _PERF_STEP[0] = lambda: float(
+        np.asarray(g_flash(q, k, v)[0]).ravel()[0])
     # BENCH_ATTN_XLA=0 skips the einsum side entirely — at long T its
     # [T, T] residuals exhaust HBM, which is exactly flash's point
     run_xla = os.environ.get("BENCH_ATTN_XLA", "1") == "1"
@@ -689,6 +805,8 @@ def main_transformer():
                            return_numpy=False)
             return out
 
+        if use_flash:
+            _PERF_STEP[0] = step
         dt, done = _timed_loop(step, warmup, steps, errors)
         return dt / done  # seconds per step
 
@@ -764,6 +882,7 @@ def main_ring_attention():
                        return_numpy=False)
         return out
 
+    _PERF_STEP[0] = step
     errors = []
     dt, done = _timed_loop(step, warmup, steps, errors)
     s_step = dt / done
@@ -783,6 +902,8 @@ def main_ring_attention():
 
 
 def _dispatch(mode):
+    if mode == "fc":
+        return main_fc()
     if mode == "lstm":
         return main_lstm()
     if mode == "attention":
@@ -830,4 +951,17 @@ if __name__ == "__main__":
     args = sys.argv[1:]
     if "--steps-per-call" in args:
         STEPS_PER_CALL = int(args[args.index("--steps-per-call") + 1])
+    if "--families" in args:
+        # run several families back-to-back, one JSON line each
+        # (e.g. `bench.py --families fc,resnet,lstm`); exit code is the
+        # worst of the runs
+        rc = 0
+        for fam in args[args.index("--families") + 1].split(","):
+            fam = fam.strip()
+            if not fam:
+                continue
+            os.environ["BENCH_MODE"] = fam
+            _CARRIED_ERRORS.clear()
+            rc = max(rc, main() or 0)
+        sys.exit(rc)
     sys.exit(main())

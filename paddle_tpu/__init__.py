@@ -52,6 +52,7 @@ from .concurrency import (make_channel, channel_send, channel_recv,
                           channel_close, Go, Select)
 from . import telemetry
 from . import inspector
+from . import roofline
 from .parallel import transpiler
 from .parallel.transpiler import DistributeTranspiler
 

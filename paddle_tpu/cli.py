@@ -452,6 +452,51 @@ def cmd_version(_args):
     return 0
 
 
+def cmd_perf(args):
+    """Roofline performance report (roofline.py): run a smoke program (or
+    read an existing trace dir) and print the per-op attribution table —
+    device time, analytic FLOPs/bytes, achieved TF/s, arithmetic
+    intensity, and the compute/memory/unattributed bound verdict — plus
+    the step-time waterfall and MFU/duty-cycle summary."""
+    import json
+
+    from paddle_tpu import roofline
+
+    probe = not args.no_probe
+    if args.trace_dir:
+        report = roofline.collect_report(args.trace_dir, (), probe=probe)
+    else:
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod, memory
+
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            spec = memory.build_smoke(args.smoke or "fit_a_line")
+            exe = fluid.Executor(fluid.TPUPlace(0))
+            exe.run(spec["startup"])
+            feed = spec["data_fn"](args.batch)
+
+            def run():
+                return exe.run(spec["main"], feed=feed,
+                               fetch_list=[spec["loss"]])
+
+            run()   # warm compile OUTSIDE the trace: attribute steps,
+                    # not the one-off XLA compile
+            report = roofline.capture(run, steps=args.steps, probe=probe)
+
+    if report is None:
+        print("perf: no report (trace empty or capture failed)")
+        return 1
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True, default=str)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    else:
+        for line in roofline.format_report(report):
+            print(line)
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="paddle_tpu",
@@ -538,6 +583,29 @@ def main(argv=None):
                        help="append the Prometheus exposition (hbm_*/"
                             "memory_* gauges) after the summary")
     p_mem.set_defaults(fn=cmd_memory)
+
+    p_perf = sub.add_parser(
+        "perf", help="roofline report: per-op FLOPs/bytes attribution, "
+                     "bound verdicts, waterfall, MFU")
+    p_perf.add_argument("--smoke", nargs="?", const="fit_a_line",
+                        default=None,
+                        help="run a built-in smoke program under a traced "
+                             "session (fit_a_line or resnet; default "
+                             "fit_a_line)")
+    p_perf.add_argument("--trace-dir",
+                        help="attribute an existing jax.profiler trace dir "
+                             "instead of running anything")
+    p_perf.add_argument("--steps", type=int, default=3,
+                        help="traced steps for --smoke (default 3)")
+    p_perf.add_argument("--batch", type=int, default=16,
+                        help="smoke-program batch size (default 16)")
+    p_perf.add_argument("--json", action="store_true",
+                        help="print the full report as JSON")
+    p_perf.add_argument("--report", metavar="PATH",
+                        help="also write the JSON report to PATH")
+    p_perf.add_argument("--no-probe", action="store_true",
+                        help="skip the matmul/HBM roofline probes")
+    p_perf.set_defaults(fn=cmd_perf)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

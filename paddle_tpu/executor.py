@@ -433,29 +433,60 @@ def padded_to_pack(padded: np.ndarray, lengths: np.ndarray,
             [outer_offs.tolist(), inner_offs.tolist()])
 
 
-def _hlo_supplier(fn, feed_vals, state_vals, rng_counter):
-    """Zero-arg lazy supplier of the block's optimized HLO text for the
-    profiler's per-op device table. Captures ONLY avals (shapes/dtypes),
-    never the arrays — state buffers are donated and must not be kept
-    alive. supply() is an AOT lower().compile(): a REAL recompile unless
-    the persistent compilation cache covers it, which is why the profiler
-    caps its supplier registry and only traced sessions pay this — at
-    stop_profiler, never inside the timed region."""
-    def _aval(x):
-        shape = getattr(x, "shape", None)
-        dtype = getattr(x, "dtype", None)
-        if shape is None or dtype is None:
-            arr = np.asarray(x)
-            shape, dtype = arr.shape, arr.dtype
-        return jax.ShapeDtypeStruct(shape, dtype)
+def _aval_of(x):
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        arr = np.asarray(x)
+        shape, dtype = arr.shape, arr.dtype
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
 
-    avals = jax.tree_util.tree_map(_aval,
+
+def _hlo_supplier(fn, feed_vals, state_vals, rng_counter):
+    """Zero-arg lazy supplier of the block's AOT-compiled executable for
+    the profiler's per-op device table (.as_text() gives the optimized HLO
+    the attribution joins against, .cost_analysis() the XLA flop count the
+    analytic cost model cross-checks). Captures ONLY avals
+    (shapes/dtypes), never the arrays — state buffers are donated and must
+    not be kept alive. supply() is an AOT lower().compile(): a REAL
+    recompile unless the persistent compilation cache covers it, which is
+    why the profiler caps its supplier registry and only traced sessions
+    pay this — at stop_profiler, never inside the timed region."""
+    avals = jax.tree_util.tree_map(_aval_of,
                                    (feed_vals, state_vals, rng_counter))
 
     def supply():
-        return fn.lower(*avals).compile().as_text()
+        return fn.lower(*avals).compile()
 
     return supply
+
+
+# Observers notified as (op, ins, outs) for every op lowered by _exec_op —
+# ins/outs are {slot: [tracer|None]}. Installed only for the duration of an
+# abstract trace (roofline.program_cost runs jax.eval_shape with one) so
+# the analytic cost model sees concrete per-op shapes/dtypes instead of
+# the ProgramDesc's -1 batch dims. Empty in normal execution: the per-op
+# overhead is one falsy check at trace time, nothing at run time.
+_op_observers: List = []
+
+
+def _cost_supplier(executor, program, feed_vals, state_vals, window=False):
+    """Zero-arg lazy supplier of the analytic per-op cost table
+    (roofline.program_cost) for the same compiled block _hlo_supplier
+    describes. Same discipline: captures only avals. window=True strips
+    the leading [K] steps axis off each feed so the table is per-step."""
+    feed_avals = {n: _aval_of(v) for n, v in feed_vals.items()}
+    if window:
+        feed_avals = {n: jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                      for n, a in feed_avals.items()}
+    state_avals = {n: _aval_of(v) for n, v in state_vals.items()}
+
+    def cost():
+        from . import roofline
+        return roofline.program_cost(executor, program, feed_avals,
+                                     state_avals)
+
+    return cost
 
 
 @jax.jit
@@ -733,6 +764,18 @@ class Executor:
                 persist_out, {}, steps, fetch_mode)
             if use_program_cache:
                 self._cache[key] = compiled
+        from . import profiler as profiler_mod
+        if profiler_mod.wants_device_table() and \
+                not profiler_mod.has_hlo_supplier(id(compiled.fn)):
+            # run_steps registers its cost analysis too: the fused window
+            # is the production training path, and the MFU campaign needs
+            # attribution exactly there (ISSUE 6 tentpole)
+            profiler_mod.register_hlo_supplier(
+                id(compiled.fn),
+                _hlo_supplier(compiled.fn, feed_vals, state_vals,
+                              np.uint32(rng_counter)),
+                _cost_supplier(self, program, feed_vals, state_vals,
+                               window=True))
 
         sig = telemetry.signature_of(feed_vals)
         new_sig = sig not in compiled.seen_sigs
@@ -1032,7 +1075,8 @@ class Executor:
                 profiler_mod.register_hlo_supplier(
                     id(compiled.fn),
                     _hlo_supplier(compiled.fn, feed_vals, state_vals,
-                                  np.uint32(rng_counter)))
+                                  np.uint32(rng_counter)),
+                    _cost_supplier(self, program, feed_vals, state_vals))
             sig = telemetry.signature_of(feed_vals)
             new_sig = sig not in compiled.seen_sigs
             compile_before = telemetry.jax_compile_seconds()
@@ -1456,6 +1500,9 @@ class Executor:
                 f"  outputs: {dict(op.desc.outputs)}\n"
                 f"  built at: {site or '<unknown>'}",
                 op_type=op.type, creation_site=site) from e
+        if _op_observers:
+            for obs in _op_observers:
+                obs(op, ins, outs)
         if t0 is not None:
             # FLAGS_benchmark parity (reference executor.cc:321): wait for
             # device completion per op and log wall time

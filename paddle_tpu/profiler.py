@@ -25,6 +25,33 @@ _active = False
 _epoch = time.perf_counter()
 
 
+# Cached telemetry handles for the host hot path: [module, family,
+# registry-generation, {event_name: child}]. telemetry.reset() clears the
+# registry, which would leave a bare cached child orphaned (observing into
+# a family no exporter sees) — the generation int-compare catches that and
+# re-resolves once instead of on every event.
+_event_hist = [None, None, -1, {}]
+
+
+def _event_child(name: str):
+    tel = _event_hist[0]
+    if tel is None:
+        from . import telemetry as tel
+        _event_hist[0] = tel
+    gen = tel.registry().generation()
+    if _event_hist[1] is None or _event_hist[2] != gen:
+        _event_hist[1] = tel.histogram(
+            "profiler_event_seconds", "host profiler event durations",
+            labels=("event",))
+        _event_hist[2] = gen
+        _event_hist[3] = {}
+    children = _event_hist[3]
+    child = children.get(name)
+    if child is None:
+        child = children[name] = _event_hist[1].labels(event=name)
+    return child
+
+
 def record_event(name: str, seconds: float, start: Optional[float] = None):
     if _active:
         _events[name].append(seconds)
@@ -33,10 +60,7 @@ def record_event(name: str, seconds: float, start: Optional[float] = None):
         # publish into the shared registry too, so one telemetry snapshot
         # answers both "which op eats the step" and "which step ate the
         # minute" (ISSUE tentpole: profiler keeps its API, feeds telemetry)
-        from . import telemetry
-        telemetry.histogram(
-            "profiler_event_seconds", "host profiler event durations",
-            labels=("event",)).labels(event=name).observe(seconds)
+        _event_child(name).observe(seconds)
 
 
 @contextlib.contextmanager
@@ -85,16 +109,21 @@ def start_profiler(state="All", trace_dir: Optional[str] = None):
         labels=("traced",)).labels(
             traced=str(bool(trace_dir)).lower()).inc()
     _hlo_suppliers.clear()
+    _steps_at_start[0] = sum(
+        telemetry.read_series("executor_steps_total").values())
     if trace_dir:
         jax.profiler.start_trace(trace_dir)
     _start_trace_dir[0] = trace_dir
 
 
 _start_trace_dir = [None]
-# id(compiled_fn) -> zero-arg callable returning the optimized HLO text;
-# registered by the executor while a traced profile is active, consumed by
-# the per-op device table at stop (paddle_tpu/xplane.py)
-_hlo_suppliers: Dict[int, object] = {}
+_steps_at_start = [0.0]
+# id(compiled_fn) -> (supplier, cost_fn): supplier is a zero-arg callable
+# returning the AOT-compiled block (or raw optimized-HLO text), cost_fn an
+# optional zero-arg callable returning the analytic per-op cost table
+# (roofline.program_cost). Registered by the executor while a traced
+# profile is active, consumed by the device report at stop.
+_hlo_suppliers: Dict[int, tuple] = {}
 
 
 def wants_device_table() -> bool:
@@ -114,21 +143,61 @@ def has_hlo_supplier(key: int) -> bool:
         len(_hlo_suppliers) >= _MAX_HLO_SUPPLIERS
 
 
-def register_hlo_supplier(key: int, supplier):
+def register_hlo_supplier(key: int, supplier, cost_fn=None):
     if len(_hlo_suppliers) < _MAX_HLO_SUPPLIERS:
-        _hlo_suppliers.setdefault(key, supplier)
+        _hlo_suppliers.setdefault(key, (supplier, cost_fn))
+
+
+def consume_suppliers() -> list:
+    """Drain the registered (supplier, cost_fn) pairs — the device report
+    is built at most once per traced session."""
+    pairs = list(_hlo_suppliers.values())
+    _hlo_suppliers.clear()
+    return pairs
+
+
+def _traced_steps() -> Optional[int]:
+    """Executor steps run during the current traced session (delta of the
+    executor_steps_total counter since start_profiler); None when no step
+    ran — the report then skips flops-rate columns rather than divide by
+    a guessed step count."""
+    from . import telemetry
+    delta = sum(telemetry.read_series(
+        "executor_steps_total").values()) - _steps_at_start[0]
+    return int(delta) if delta > 0 else None
+
+
+def _end_trace():
+    trace_dir = _start_trace_dir[0]
+    if trace_dir:
+        jax.profiler.stop_trace()
+        _start_trace_dir[0] = None
+    return trace_dir
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
     global _active
     _active = False
-    trace_dir = _start_trace_dir[0]
-    if trace_dir:
-        jax.profiler.stop_trace()
-        _start_trace_dir[0] = None
+    trace_dir = _end_trace()
     _print_table(sorted_key)
     if trace_dir:
         _print_device_table(trace_dir, sorted_key)
+
+
+def finish_trace_report(steps: Optional[int] = None, probe: bool = True):
+    """Silent counterpart of stop_profiler for programmatic capture
+    (bench.py, roofline.capture): stop the traced session and return the
+    roofline report dict without printing anything — bench stdout must
+    stay one-JSON-line-per-config. Returns None when no trace was active."""
+    global _active
+    _active = False
+    trace_dir = _end_trace()
+    if not trace_dir:
+        return None
+    from . import roofline
+    return roofline.collect_report(
+        trace_dir, consume_suppliers(),
+        steps=steps if steps is not None else _traced_steps(), probe=probe)
 
 
 def _print_device_table(trace_dir, sorted_key=None):
@@ -136,39 +205,28 @@ def _print_device_table(trace_dir, sorted_key=None):
     r4 #8; reference ParseEvents, platform/profiler.h:137-166): xplane
     per-instruction timings joined with each compiled module's
     metadata op_name (which carries the executor's pd.<op_type> named
-    scope). Re-lowers each registered block from avals to read its
-    optimized HLO — served from jax's compilation cache when warm."""
-    from . import xplane
+    scope), enriched by roofline.py with analytic FLOPs/bytes, achieved
+    TF/s and a compute/memory/unattributed verdict. Unmapped device time
+    is pooled under "(unattributed)" so fractions sum to the true device
+    total. Re-lowers each registered block from avals — served from jax's
+    compilation cache when warm."""
+    from . import roofline
 
-    mapping = {}
-    for supplier in _hlo_suppliers.values():
-        try:
-            mapping.update(xplane.hlo_op_names(supplier()))
-        except Exception as e:  # noqa: BLE001 - table is best-effort
-            print(f"[device] (hlo attribution unavailable: {e})")
-    _hlo_suppliers.clear()
-    if not mapping:
-        return
+    pairs = consume_suppliers()
     try:
-        instr_ps = xplane.aggregate_dir(trace_dir)
-        agg = xplane.attribute(instr_ps, mapping)
+        report = roofline.collect_report(trace_dir, pairs,
+                                         steps=_traced_steps())
     except Exception as e:  # noqa: BLE001 - truncated/foreign .xplane.pb
         print(f"[device] (trace unreadable: {type(e).__name__}: {e})")
         return
-    if not agg:
+    if report is None or not report.get("rows"):
         return
-    rows = sorted(agg.items(), key=lambda kv: -kv[1])
-    total = sum(agg.values())
-    from . import telemetry
-    for name, ps in rows:
-        telemetry.counter(
-            "device_op_seconds_total",
-            "device time attributed to IR ops across traced sessions",
-            labels=("op",)).labels(op=name).inc(ps / 1e12)
-    print(f"{'Device op (jit)':40s} {'Total(ms)':>12s} {'Frac':>8s}")
-    for name, ps in rows:
-        print(f"[device] {name:31s} {ps / 1e9:12.4f} "
-              f"{ps / total:8.1%}")
+    if not report.get("mapped") and not pairs:
+        # nothing was registered (eager run, foreign trace): keep the old
+        # silent behaviour instead of printing an all-unattributed table
+        return
+    for line in roofline.format_report(report):
+        print(line)
 
 
 def _print_table(sorted_key=None):

@@ -176,6 +176,7 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.RLock()
         self._families: Dict[str, _Family] = {}
+        self._generation = 0
 
     def _get_or_make(self, cls, name, help, labels, buckets=None):
         with self._lock:
@@ -208,6 +209,13 @@ class MetricsRegistry:
     def clear(self):
         with self._lock:
             self._families.clear()
+            self._generation += 1
+
+    def generation(self) -> int:
+        """Bumped by clear()/reset(); lets hot paths that cache a family or
+        child handle (profiler.record_event) self-invalidate with one int
+        compare instead of re-resolving through the registry lock."""
+        return self._generation
 
     # --- snapshots ----------------------------------------------------------
     def local_snapshot(self) -> Dict[str, Any]:

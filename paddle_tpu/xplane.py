@@ -5,7 +5,8 @@ this image can't load them (TF version skew), so this decodes the wire
 format directly — only the fields needed to aggregate device-op time:
 
   XSpace.planes=1 / XPlane{name=2, lines=3, event_metadata=4}
-  XLine{events=4} / XEvent{metadata_id=1, duration_ps=3}
+  XLine{name=2, timestamp_ns=3, events=4}
+  XEvent{metadata_id=1, offset_ps=2, duration_ps=3}
   XEventMetadata map entry {key=1, value=2} / XEventMetadata{id=1, name=2}
 
 The executor wraps every IR op's lowering in jax.named_scope("pd.<type>")
@@ -25,7 +26,8 @@ import re
 from typing import Dict, Optional
 
 __all__ = ["aggregate", "aggregate_dir", "aggregate_lines", "hlo_op_names",
-           "attribute", "category", "fields", "parse_plane"]
+           "attribute", "category", "fields", "parse_plane",
+           "plane_events", "timeline_dir"]
 
 
 def _varint(buf, i):
@@ -119,6 +121,64 @@ def aggregate_lines(path) -> Dict[str, list]:
     return out
 
 
+def plane_events(path) -> Dict[str, list]:
+    """-> {plane_name: [line, ...]} where each line is
+    {"name": str, "timestamp_ns": int,
+     "events": [(event_name, offset_ps, duration_ps), ...]}.
+
+    The full-resolution view of the same planes `aggregate_lines` sums:
+    XLine.timestamp_ns anchors the line on the wall clock and
+    XEvent.offset_ps places each event within the line, so
+    timestamp_ns*1e3 + offset_ps orders events across lines and planes —
+    the timeline the waterfall/duty-cycle analysis needs."""
+    buf = open(path, "rb").read()
+    out: Dict[str, list] = {}
+    for fno, wt, v in fields(buf):
+        if fno != 1 or wt != 2:
+            continue
+        pname, lines, meta = parse_plane(v)
+        per_line = out.setdefault(pname, [])
+        for line in lines:
+            lname = ""
+            ts_ns = 0
+            events = []
+            for f2, w2, v2 in fields(line):
+                if f2 == 2 and w2 == 2:      # XLine.name
+                    lname = v2.decode("utf-8", "replace")
+                elif f2 == 3 and w2 == 0:    # XLine.timestamp_ns
+                    ts_ns = v2
+                elif f2 == 4 and w2 == 2:    # XLine.events
+                    mid = off = dur = 0
+                    for f3, w3, v3 in fields(v2):
+                        if f3 == 1 and w3 == 0:
+                            mid = v3
+                        elif f3 == 2 and w3 == 0:
+                            off = v3
+                        elif f3 == 3 and w3 == 0:
+                            dur = v3
+                    events.append((meta.get(mid, f"#{mid}"), off, dur))
+            per_line.append({"name": lname, "timestamp_ns": ts_ns,
+                             "events": events})
+    return out
+
+
+def timeline_dir(trace_dir) -> list:
+    """Merge every .xplane.pb under trace_dir into a flat list of
+    {"plane", "line", "timestamp_ns", "events"} records (events carry
+    (name, offset_ps, duration_ps)), device planes first."""
+    records = []
+    for p in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                       recursive=True):
+        for pname, lines in plane_events(p).items():
+            for line in lines:
+                records.append({"plane": pname, "line": line["name"],
+                                "timestamp_ns": line["timestamp_ns"],
+                                "events": line["events"]})
+    records.sort(key=lambda r: (not r["plane"].startswith("/device:"),
+                                r["plane"], r["timestamp_ns"]))
+    return records
+
+
 def aggregate(path) -> Dict[str, Dict[str, int]]:
     """-> {plane_name: {event_name: total_ps}} (lines summed)."""
     out = {}
@@ -130,6 +190,20 @@ def aggregate(path) -> Dict[str, Dict[str, int]]:
     return out
 
 
+_INSTR_LIKE = re.compile(r"[\w.\-]+\Z")
+
+
+def instr_like(name: str) -> bool:
+    """True when an event name looks like an HLO instruction ('dot.4',
+    'fusion.12', 'reduce-window') rather than host bookkeeping. Host
+    planes interleave python-source events ('$profiler.py:226 trace'),
+    runtime markers ('TfrtCpuExecutable::Execute',
+    'ThunkExecutor::Execute (wait...)') and dispatch wrappers
+    ('PjitFunction(f)') with the real instruction events — all of which
+    contain '$', ':', '(', or spaces that no instruction name can."""
+    return _INSTR_LIKE.fullmatch(name) is not None
+
+
 def aggregate_dir(trace_dir) -> Dict[str, int]:
     """Merge the DEVICE planes of every .xplane.pb under trace_dir into ONE
     {event_name: total_ps} map. Within a device plane an instruction shows
@@ -139,24 +213,25 @@ def aggregate_dir(trace_dir) -> Dict[str, int]:
     (per-core time adds up) and files.
 
     Fallback: traces with no '/device:' plane at all (e.g. CPU-backend jax
-    writes only host planes) keep the old all-planes line-summed merge so
-    the table still has rows to join against the HLO mapping."""
+    writes only host planes) merge the host planes instead — with the SAME
+    per-name max-across-lines dedup (host planes repeat events on derived
+    lines too), and restricted to instruction-like event names so python
+    source events and runtime markers (`instr_like`) don't swamp the
+    table."""
     device: Dict[str, int] = {}
     host: Dict[str, int] = {}
     for p in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                        recursive=True):
         for pname, per_line in aggregate_lines(p).items():
-            if pname.startswith("/device:"):
-                plane: Dict[str, int] = {}
-                for line_agg in per_line:
-                    for name, ps in line_agg.items():
-                        plane[name] = max(plane.get(name, 0), ps)
-                for name, ps in plane.items():
-                    device[name] = device.get(name, 0) + ps
-            else:
-                for line_agg in per_line:
-                    for name, ps in line_agg.items():
-                        host[name] = host.get(name, 0) + ps
+            target = device if pname.startswith("/device:") else host
+            plane: Dict[str, int] = {}
+            for line_agg in per_line:
+                for name, ps in line_agg.items():
+                    if target is host and not instr_like(name):
+                        continue
+                    plane[name] = max(plane.get(name, 0), ps)
+            for name, ps in plane.items():
+                target[name] = target.get(name, 0) + ps
     return device if device else host
 
 

@@ -87,6 +87,60 @@ class TestCLI:
         assert "steps/s" in r.stdout
 
 
+class TestPerfCLI:
+    def test_perf_smoke(self, tmp_path):
+        # env probe overrides keep the run hermetic and fast (no
+        # sustained-matmul / bandwidth measurement in CI)
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                   PADDLE_TPU_SUSTAINED_TFLOPS="0.5",
+                   PADDLE_TPU_HBM_GBPS="20")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu", "perf", "--smoke",
+             "--steps=2", "--batch=8"],
+            capture_output=True, text=True, cwd=str(tmp_path), env=env,
+            timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr[-1500:]
+        out = r.stdout
+        assert "(unattributed)" in out
+        assert "[waterfall]" in out and "[roofline]" in out
+        assert "[mfu]" in out
+        rows = [ln.split() for ln in out.splitlines()
+                if ln.startswith("[device] ")]
+        data_rows = [t for t in rows
+                     if len(t) >= 8 and t[3].endswith("%")]
+        assert data_rows, out
+        # every row: op, ms, frac, GFLOPs, MB, TF/s, AI, bound verdict
+        assert all(t[-1] in ("compute", "memory", "unattributed")
+                   for t in data_rows), data_rows
+        # fractions (incl. the unattributed pool) sum to the device total
+        total = sum(float(t[3].rstrip("%")) for t in data_rows)
+        assert abs(total - 100.0) < 1.0, out
+        # at least one attributed row carries real numbers end to end
+        attributed = [t for t in data_rows
+                      if t[-1] in ("compute", "memory")]
+        assert attributed, out
+        assert all(t[4] != "-" and t[6] != "-" for t in attributed), out
+
+    def test_perf_smoke_json(self, tmp_path):
+        import json as json_mod
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                   PADDLE_TPU_SUSTAINED_TFLOPS="0.5",
+                   PADDLE_TPU_HBM_GBPS="20")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu", "perf", "--smoke",
+             "--steps=2", "--batch=8", "--json"],
+            capture_output=True, text=True, cwd=str(tmp_path), env=env,
+            timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr[-1500:]
+        report = json_mod.loads(r.stdout)
+        assert report["rows"] and report["mapped"]
+        for row in report["rows"]:
+            assert {"op", "ps", "frac", "flops", "bytes", "tflops",
+                    "bound"} <= set(row)
+        assert report["ridge_intensity"] == 25.0
+        assert report.get("device_duty_cycle") is not None
+
+
 class TestCheckgrad:
     def test_checkgrad_passes(self, tmp_path):
         cfg = tmp_path / "conf.py"

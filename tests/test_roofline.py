@@ -1,0 +1,274 @@
+"""Roofline attribution (ISSUE 6): analytic op costs, probe/ridge math,
+synthetic-xplane report joins, waterfall bucketing, and the bench-facing
+top_ops summary. The synthetic traces hand-encode the XSpace wire format
+so the tests pin the parser and the report logic together without a
+device."""
+
+import numpy as np
+
+from paddle_tpu import roofline, xplane
+
+
+class A:
+    """Minimal aval stand-in: anything with .shape/.dtype."""
+
+    def __init__(self, shape, dtype=np.float32):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+
+
+# --- hand-rolled XSpace encoder (mirrors xplane.py's decoder) ---------------
+
+def _varint(n):
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _field(fno, wt, payload):
+    key = _varint((fno << 3) | wt)
+    if wt == 2:
+        return key + _varint(len(payload)) + payload
+    return key + _varint(payload)
+
+
+def _event(mid, off_ps, dur_ps):
+    return (_field(1, 0, mid) + _field(2, 0, off_ps)
+            + _field(3, 0, dur_ps))
+
+
+def _line(name, ts_ns, events):
+    buf = _field(2, 2, name.encode()) + _field(3, 0, ts_ns)
+    for e in events:
+        buf += _field(4, 2, e)
+    return buf
+
+
+def _meta(mid, name):
+    inner = _field(1, 0, mid) + _field(2, 2, name.encode())
+    return _field(1, 0, mid) + _field(2, 2, inner)
+
+
+def _plane(name, lines, metas):
+    buf = _field(2, 2, name.encode())
+    for ln in lines:
+        buf += _field(3, 2, ln)
+    for m in metas:
+        buf += _field(4, 2, m)
+    return buf
+
+
+def _write_xspace(path, planes):
+    path.write_bytes(b"".join(_field(1, 2, p) for p in planes))
+
+
+class TestOpCost:
+    def test_matmul_flops_and_bytes(self):
+        ins = {"X": [A((64, 128))], "Y": [A((128, 32))]}
+        outs = {"Out": [A((64, 32))]}
+        flops, bytes_ = roofline.op_cost("matmul", ins, outs)
+        assert flops == 2 * 64 * 128 * 32
+        assert bytes_ == 4 * (64 * 128 + 128 * 32 + 64 * 32)
+
+    def test_matmul_transpose_x_uses_other_contraction_dim(self):
+        ins = {"X": [A((128, 64))], "Y": [A((128, 32))]}
+        outs = {"Out": [A((64, 32))]}
+        flops, _ = roofline.op_cost("matmul", ins, outs,
+                                    {"transpose_X": True})
+        assert flops == 2 * 64 * 32 * 128
+
+    def test_mul_respects_x_num_col_dims(self):
+        ins = {"X": [A((8, 4, 16))], "Y": [A((64, 10))]}
+        outs = {"Out": [A((8, 10))]}
+        flops, _ = roofline.op_cost("mul", ins, outs, {"x_num_col_dims": 1})
+        assert flops == 2 * 8 * 10 * (4 * 16)
+
+    def test_conv2d_counts_macs_from_filter(self):
+        ins = {"Input": [A((2, 3, 16, 16))], "Filter": [A((8, 3, 3, 3))]}
+        outs = {"Output": [A((2, 8, 16, 16))]}
+        flops, _ = roofline.op_cost("conv2d", ins, outs)
+        assert flops == 2 * (2 * 8 * 16 * 16) * 3 * 3 * 3
+
+    def test_grad_op_doubles_forward_work(self):
+        ins = {"X": [A((64, 128))], "Y": [A((128, 32))],
+               "Out@GRAD": [A((64, 32))]}
+        outs = {"X@GRAD": [A((64, 128))], "Y@GRAD": [A((128, 32))]}
+        flops, _ = roofline.op_cost("matmul_grad", ins, outs)
+        assert flops == roofline._GRAD_FACTOR * 2 * 64 * 128 * 32
+
+    def test_data_movement_is_zero_flops_nonzero_bytes(self):
+        ins = {"X": [A((128, 64))]}
+        outs = {"Out": [A((64, 128))]}
+        flops, bytes_ = roofline.op_cost("reshape2", ins, outs)
+        assert flops == 0.0
+        assert bytes_ == 4 * 2 * 128 * 64
+
+    def test_reduce_costs_input_elems(self):
+        ins = {"X": [A((32, 32))]}
+        outs = {"Out": [A(())]}
+        flops, _ = roofline.op_cost("reduce_sum", ins, outs)
+        assert flops == 32 * 32
+
+
+class TestProbes:
+    def test_env_overrides_and_ridge(self, monkeypatch):
+        monkeypatch.setattr(roofline, "_PROBES", {})
+        monkeypatch.setenv("PADDLE_TPU_SUSTAINED_TFLOPS", "0.5")
+        monkeypatch.setenv("PADDLE_TPU_HBM_GBPS", "20")
+        p = roofline.ensure_probes()
+        assert p["sustained_tflops"] == 0.5
+        assert p["hbm_gbps"] == 20.0
+        assert p["ridge"] == (0.5e12) / (20e9)   # 25 flops/byte
+
+    def test_probe_false_leaves_values_unmeasured(self, monkeypatch):
+        monkeypatch.setattr(roofline, "_PROBES", {})
+        monkeypatch.delenv("PADDLE_TPU_SUSTAINED_TFLOPS", raising=False)
+        monkeypatch.delenv("PADDLE_TPU_HBM_GBPS", raising=False)
+        p = roofline.ensure_probes(probe=False)
+        assert p["sustained_tflops"] is None or "sustained_tflops" \
+            not in roofline._PROBES
+        assert p["ridge"] is None
+
+
+class TestSyntheticReport:
+    """End-to-end collect_report over a hand-encoded device plane: the
+    attribution join, the per-row verdicts against the ridge, the
+    (unattributed) pool, and the telemetry gauges."""
+
+    HLO = """
+  %fusion.1 = f32[256,256] fusion(f32[256,256] %p0), kind=kOutput, metadata={op_name="jit(step)/pd.matmul/dot_general"}
+  %broadcast.7 = f32[256,256] broadcast(f32[] %c), metadata={op_name="jit(step)/pd.relu/max"}
+"""
+
+    def _trace(self, tmp_path):
+        # fusion.1 appears on the raw line (40us) AND a derived line
+        # (40us again): dedup must keep 40, not 80. unknown.9 has no HLO
+        # mapping -> "(unattributed)".
+        metas = [_meta(1, "fusion.1"), _meta(2, "broadcast.7"),
+                 _meta(3, "unknown.9")]
+        raw = _line("XLA Ops", 1000, [_event(1, 0, 40_000_000),
+                                      _event(2, 40_000_000, 10_000_000),
+                                      _event(3, 50_000_000, 10_000_000)])
+        derived = _line("Steps", 1000, [_event(1, 0, 40_000_000)])
+        _write_xspace(tmp_path / "t.xplane.pb",
+                      [_plane("/device:TPU:0", [raw, derived], metas)])
+
+    def _suppliers(self):
+        n = 256
+        cost = {"ops": {
+            "matmul": {"flops": 2.0 * n ** 3,
+                       "bytes": 3.0 * n * n * 4, "count": 1},
+            "relu": {"flops": float(n * n),
+                     "bytes": 2.0 * n * n * 4, "count": 1}}}
+        cost["total_flops"] = sum(d["flops"] for d in cost["ops"].values())
+        cost["total_bytes"] = sum(d["bytes"] for d in cost["ops"].values())
+        return [(lambda: self.HLO, lambda: cost)]
+
+    def test_verdicts_and_unattributed_pool(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(roofline, "_PROBES", {})
+        monkeypatch.setenv("PADDLE_TPU_SUSTAINED_TFLOPS", "0.5")
+        monkeypatch.setenv("PADDLE_TPU_HBM_GBPS", "20")
+        self._trace(tmp_path)
+        report = roofline.collect_report(str(tmp_path), self._suppliers(),
+                                         steps=2)
+        assert report is not None and report["mapped"]
+        rows = {r["op"]: r for r in report["rows"]}
+        assert set(rows) == {"matmul", "relu", roofline.UNATTRIBUTED}
+        # dedup: 40us once, not the raw+derived 80us
+        assert rows["matmul"]["ps"] == 40_000_000
+        # matmul intensity 2*256^3/(3*256^2*4) ~ 42.7 >= ridge 25
+        assert rows["matmul"]["bound"] == "compute"
+        # relu intensity 256^2/(2*256^2*4) = 0.125 < 25
+        assert rows["relu"]["bound"] == "memory"
+        assert rows[roofline.UNATTRIBUTED]["bound"] == "unattributed"
+        assert rows[roofline.UNATTRIBUTED]["flops"] is None
+        assert abs(sum(r["frac"] for r in report["rows"]) - 1.0) < 1e-9
+        # achieved TF/s: flops * steps over the op's device time
+        mm = rows["matmul"]
+        assert abs(mm["tflops"]
+                   - (mm["flops"] * 2) / (mm["ps"] / 1e12) / 1e12) < 1e-9
+
+    def test_format_report_and_top_ops(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(roofline, "_PROBES", {})
+        monkeypatch.setenv("PADDLE_TPU_SUSTAINED_TFLOPS", "0.5")
+        monkeypatch.setenv("PADDLE_TPU_HBM_GBPS", "20")
+        self._trace(tmp_path)
+        report = roofline.collect_report(str(tmp_path), self._suppliers(),
+                                         steps=2)
+        lines = roofline.format_report(report)
+        device_rows = [ln for ln in lines if ln.startswith("[device] ")]
+        assert device_rows[0].split()[1] == "matmul"
+        assert any(roofline.UNATTRIBUTED in ln for ln in device_rows)
+        assert any(ln.startswith("[roofline]") for ln in lines)
+        top = roofline.top_ops(report, k=2)
+        assert len(top) == 2 and top[0]["op"] == "matmul"
+        assert top[0]["bound"] == "compute"
+        assert top[0]["gflops"] == round(2.0 * 256 ** 3 / 1e9, 3)
+
+    def test_foreign_trace_without_suppliers_still_reports(self, tmp_path,
+                                                           monkeypatch):
+        monkeypatch.setattr(roofline, "_PROBES", {})
+        monkeypatch.setenv("PADDLE_TPU_SUSTAINED_TFLOPS", "0.5")
+        monkeypatch.setenv("PADDLE_TPU_HBM_GBPS", "20")
+        self._trace(tmp_path)
+        report = roofline.collect_report(str(tmp_path), ())
+        assert report is not None and not report["mapped"]
+        assert all(r["bound"] == "unattributed" for r in report["rows"])
+
+
+class TestWaterfall:
+    def test_buckets_and_duty_cycle(self, tmp_path):
+        # busiest line: compute 40us, all-reduce 20us, infeed copy 10us,
+        # then a 30us hole before a final 0-width marker -> span 100us
+        metas = [_meta(1, "fusion.1"), _meta(2, "all-reduce.2"),
+                 _meta(3, "copy.3"), _meta(4, "fusion.4")]
+        busy = _line("XLA Ops", 1000, [
+            _event(1, 0, 40_000_000),
+            _event(2, 40_000_000, 20_000_000),
+            _event(3, 60_000_000, 10_000_000),
+            _event(4, 100_000_000, 0)])
+        idle = _line("Steps", 1000, [_event(1, 0, 40_000_000)])
+        _write_xspace(tmp_path / "t.xplane.pb",
+                      [_plane("/device:TPU:0", [busy, idle], metas)])
+        wf = roofline.waterfall(str(tmp_path))
+        assert wf is not None
+        assert wf["compute_ps"] == 40_000_000
+        assert wf["collective_ps"] == 20_000_000
+        assert wf["infeed_ps"] == 10_000_000
+        assert wf["span_ps"] == 100_000_000
+        assert wf["host_gap_ps"] == 30_000_000
+        assert abs(wf["device_duty_cycle"] - 0.7) < 1e-9
+
+    def test_host_fallback_ignores_bookkeeping_lines(self, tmp_path):
+        # CPU-backend shape: a python line spanning the whole session and
+        # an XLA thread line with the real instructions. The waterfall
+        # must anchor on the instruction line.
+        metas = [_meta(1, "$profiler.py:226 trace"), _meta(2, "dot.3")]
+        py = _line("python", 500, [_event(1, 0, 1_000_000_000)])
+        xla = _line("tf_XLATfrtCpuClient/1", 500,
+                    [_event(2, 0, 50_000_000)])
+        _write_xspace(tmp_path / "t.xplane.pb",
+                      [_plane("/host:CPU", [py, xla], metas)])
+        wf = roofline.waterfall(str(tmp_path))
+        assert wf is not None
+        assert wf["compute_ps"] == 50_000_000
+        assert wf["span_ps"] == 50_000_000
+        assert wf["device_duty_cycle"] == 1.0
+
+
+class TestAggregateDedup:
+    def test_device_plane_max_across_lines_then_sum_across_planes(
+            self, tmp_path):
+        metas = [_meta(1, "fusion.1")]
+        raw = _line("XLA Ops", 0, [_event(1, 0, 10)])
+        derived = _line("Steps", 0, [_event(1, 0, 7)])
+        p0 = _plane("/device:TPU:0", [raw, derived], metas)
+        p1 = _plane("/device:TPU:1", [raw], metas)
+        _write_xspace(tmp_path / "t.xplane.pb", [p0, p1])
+        agg = xplane.aggregate_dir(str(tmp_path))
+        # per plane: max(10, 7) = 10; across planes: 10 + 10
+        assert agg == {"fusion.1": 20}

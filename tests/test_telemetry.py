@@ -450,7 +450,9 @@ class TestXplaneAggregation:
                        [_xline([_xevent(1, 10)]), _xline([_xevent(1, 5)])],
                        meta)
         agg = xplane.aggregate_dir(self._write(tmp_path, [host]))
-        assert agg == {"op.a": 15}    # old line-summed behavior
+        # host fallback applies the SAME per-name max-across-lines dedup
+        # as device planes (derived lines double-count there too)
+        assert agg == {"op.a": 10}
 
     def test_aggregate_lines_per_line_view(self, tmp_path):
         from paddle_tpu import xplane

@@ -30,7 +30,10 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8, plus
 SCALE_MODEL=smallnet_mnist_cifar SCALE_BS=16 to keep 1-core compiles
 quick.
 
-Prints one JSON line per mesh size plus a summary line.
+Prints one JSON line per mesh size plus a summary line. Each per-mesh
+line also carries roofline attribution (`top_ops`, `bound`,
+`device_duty_cycle` — see paddle_tpu/roofline.py) from a short traced
+re-run of the compiled step; SCALE_PERF=0 skips that pass.
 """
 
 import json
@@ -136,8 +139,39 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
                 peak_hbm = rec.total_bytes
             except Exception:
                 pass
+            perf = _perf_fields(run_one)
     assert np.isfinite(final)
-    return batch * steps / dt, peak_hbm
+    return batch * steps / dt, peak_hbm, perf
+
+
+def _perf_fields(run_one):
+    """`top_ops` / `bound` / `device_duty_cycle` for the per-mesh JSON line
+    (same contract as bench.py): re-run the already-compiled step a few
+    times under a silent traced session and join the roofline report, so
+    the sweep shows WHERE each mesh size spends its step next to how fast
+    it goes. SCALE_PERF=0 skips it; any failure degrades to no extra
+    fields — the scaling line itself must never die here."""
+    if os.environ.get("SCALE_PERF", "1") != "1":
+        return {}
+    try:
+        from paddle_tpu import roofline
+
+        def step():
+            float(np.asarray(run_one()).ravel()[0])
+
+        report = roofline.capture(step, steps=3)
+        if not report:
+            return {}
+        out = {"top_ops": roofline.top_ops(report),
+               "device_duty_cycle": report.get("device_duty_cycle")}
+        attributed = [r for r in report["rows"]
+                      if r["bound"] != "unattributed"]
+        out["bound"] = (attributed[0]["bound"] if attributed
+                        else "unattributed")
+        return out
+    except Exception as e:  # noqa: BLE001 - attribution is best-effort
+        print(f"perf attribution skipped: {e}", file=sys.stderr)
+        return {}
 
 
 def main(argv):
@@ -168,16 +202,17 @@ def main(argv):
             f"{len(jax.devices())} available devices")
     results = {}
     for n in sizes:
-        sps, peak_hbm = measure(n, steps_per_call=steps_per_call)
+        sps, peak_hbm, perf = measure(n, steps_per_call=steps_per_call)
         results[n] = sps
         base = results[min(results)]
         eff = sps / (base / min(results) * n)
-        print(json.dumps({"devices": n,
-                          "samples_per_sec": round(sps, 2),
-                          "scaling_efficiency": round(eff, 4),
-                          "steps_per_call": steps_per_call,
-                          "peak_hbm_bytes": peak_hbm}),
-              flush=True)
+        line = {"devices": n,
+                "samples_per_sec": round(sps, 2),
+                "scaling_efficiency": round(eff, 4),
+                "steps_per_call": steps_per_call,
+                "peak_hbm_bytes": peak_hbm}
+        line.update(perf)
+        print(json.dumps(line), flush=True)
     if len(results) > 1:
         top = max(results)
         base = results[min(results)]

@@ -3,6 +3,11 @@ xplane traces (the tensorboard profile plugin in this image can't load
 them — TF version skew — so this decodes the wire format directly).
 
 Usage: python tools/xplane.py <trace_dir_or_file> [top_n]
+       python tools/xplane.py --timeline <trace_dir_or_file> [max_events]
+
+The default view aggregates per-op totals; --timeline prints each line's
+events in execution order (XLine.timestamp_ns anchor + XEvent.offset_ps),
+the raw view behind the profiler's step-time waterfall.
 """
 
 from __future__ import annotations
@@ -24,9 +29,37 @@ _spec.loader.exec_module(_xplane)
 aggregate, category = _xplane.aggregate, _xplane.category
 
 
+def timeline(target, limit):
+    if os.path.isdir(target):
+        records = _xplane.timeline_dir(target)
+    else:
+        records = [{"plane": pname, "line": line["name"],
+                    "timestamp_ns": line["timestamp_ns"],
+                    "events": line["events"]}
+                   for pname, lines in _xplane.plane_events(target).items()
+                   for line in lines]
+    for rec in records:
+        if not rec["events"]:
+            continue
+        print(f"-- {rec['plane']} / '{rec['line']}' "
+              f"@ {rec['timestamp_ns']} ns")
+        evs = sorted(rec["events"], key=lambda e: e[1])[:limit]
+        base = evs[0][1]
+        for name, off, dur in evs:
+            print(f"   +{(off - base) / 1e6:12.3f} us  "
+                  f"{dur / 1e6:10.3f} us  {name[:90]}")
+
+
 def main():
-    target = sys.argv[1] if len(sys.argv) > 1 else "."
-    top = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    args = sys.argv[1:]
+    want_timeline = "--timeline" in args
+    if want_timeline:
+        args.remove("--timeline")
+    target = args[0] if args else "."
+    top = int(args[1]) if len(args) > 1 else 30
+    if want_timeline:
+        timeline(target, top)
+        return
     if os.path.isdir(target):
         paths = glob.glob(os.path.join(target, "**", "*.xplane.pb"),
                           recursive=True)
