@@ -401,6 +401,12 @@ def _perf_fields(probe=None):
             return {}
         out = {"top_ops": roofline.top_ops(report),
                "device_duty_cycle": report.get("device_duty_cycle")}
+        hc = report.get("hlo_counts")
+        if hc:
+            # per-step kernel-count trend: fusion wins show up as fewer
+            # HLO instructions/fusions at the same img/s (ISSUE 7)
+            out["hlo_instructions"] = hc["instructions"]
+            out["hlo_fusions"] = hc["fusions"]
         attributed = [r for r in report["rows"]
                       if r["bound"] != "unattributed"]
         out["bound"] = (attributed[0]["bound"] if attributed

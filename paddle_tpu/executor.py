@@ -1570,8 +1570,28 @@ class Executor:
         env.update(feed_vals)
         ctx = LoweringContext(self, program, rng_key, lod_map)
         block = program.global_block()
-        for op in block.ops:
-            self._exec_op(ctx, op, env)
+        # trace-time fusion pass (ops/fusion.py, PADDLE_TPU_FUSION=1):
+        # planned windows lower as one fused op at their anchor index;
+        # everything else keeps the per-op path. The plan is fetch-
+        # agnostic, so fold-mode elision re-checks against the names this
+        # trace must materialize.
+        from .ops import fusion as fusion_mod
+        groups = fusion_mod.plan(program)
+        if not groups:
+            for op in block.ops:
+                self._exec_op(ctx, op, env)
+        else:
+            protected = set(fetch_names) | set(persist_out)
+            ops = block.ops
+            i = 0
+            while i < len(ops):
+                g = groups.get(i)
+                if g is not None:
+                    fusion_mod.execute_group(self, ctx, g, env, protected)
+                    i = g.end
+                else:
+                    self._exec_op(ctx, ops[i], env)
+                    i += 1
         if ctx.layouts:
             # fetches and persistable state leave the trace in canonical
             # NCHW — the internal NHWC convention never escapes a run

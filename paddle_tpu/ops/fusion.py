@@ -1,0 +1,780 @@
+"""Trace-time kernel fusion pass over the ProgramDesc.
+
+The reference treats Fluid programs as compiler IR (PAPER.md: the
+transpilers rewrite ProgramDesc graphs; data_layout_transform rewrites
+layouts) — this module is the fusion-pass instance of that idea, applied
+at trace time like the NHWC tag pass (ops/layout.py). `plan()` pattern-
+matches CONTIGUOUS op windows in the global block:
+
+    conv2d/depthwise_conv2d -> batch_norm [-> activation]
+    mul -> elementwise_add(1-D bias) [-> activation]
+    elementwise/activation chains (incl. their _grad variants)
+    runs of same-type sgd/momentum/adam updates sharing one LR
+
+and the executor lowers each match as ONE fused op instead of N separate
+lowerings. Because matches are contiguous windows, executing a group at
+its anchor preserves the original op order exactly — no dependency
+analysis is needed, and the compose paths below run each member through
+the executor's own `_exec_op` (prepass, registry lowering, SEQLEN and
+layout-tag bookkeeping), so they are bitwise identical to the unfused
+trace. The only value-rewriting paths are:
+
+  * inference-mode conv+bn: BN folds into the conv filter/bias
+    (w' = w * scale/sqrt(var+eps), b' = bias - mean * that) and the
+    conv's own output is elided from the trace when nothing else
+    consumes it;
+  * training-mode bn[+act] on bf16 NHWC activations: a single Pallas
+    TPU kernel (one-pass E[x^2]-E[x]^2 statistics, matching the unfused
+    bf16 path) normalizes and activates in one VMEM sweep;
+  * optimizer buckets: dense param/grad/moment tensors concatenate into
+    one flat same-dtype buffer per bucket and apply the identical
+    elementwise update once (bitwise equal per element; SelectedRows
+    grads keep their per-param sparse fast path).
+
+Gradients stay consistent for free: fused windows only ever cover
+forward ops whose `<type>_grad` ops re-trace the UNFUSED forward
+lowering (ops/registry.py generic vjp), member-level layout tags are
+kept live during compose execution, and backward elementwise chains
+fuse through the same compose machinery.
+
+Env-gated by PADDLE_TPU_FUSION=1 (default on); per-reason fallback
+counters (`fusion_fallback_total`) mirror executor_window_fallback_total.
+Applies to the traced global block only — eager mode and control-flow
+sub-blocks run per-op as before.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.desc import OpDesc
+from . import layout as layout_mod
+from . import optimizer_ops
+from .common import SelectedRowsVal, maybe_dense
+from .math_ops import _activations
+from .registry import NO_GRAD, register
+
+# default ON; PADDLE_TPU_FUSION=0 restores the per-op trace
+FUSION_OPT = os.environ.get("PADDLE_TPU_FUSION", "1") == "1"
+
+# --- pattern tables (tools/check_registry.py lints these against the
+# --- registry so a typo can't silently disable an optimization) ---------
+
+CONV_OPS = frozenset({"conv2d", "depthwise_conv2d"})
+
+# activations fusable as a window tail: unary X->Out, layout-agnostic,
+# and expressible inside the Pallas bn+act kernel (static attrs only)
+ACT_OPS = frozenset({
+    "relu", "relu6", "leaky_relu", "sigmoid", "tanh", "elu", "swish",
+    "brelu", "hard_sigmoid", "soft_relu",
+})
+
+# elementwise chain members (matched by base type, so their _grad
+# variants ride along): the layout-agnostic pass-through set
+CHAIN_OPS = frozenset(
+    n for n in layout_mod.AGNOSTIC_OPS if not n.endswith("_grad"))
+
+OPTIMIZER_BUCKET_OPS = frozenset({"sgd", "momentum", "adam"})
+
+FUSED_OP_TYPES = (
+    "fused_conv_bn_act", "fused_bn_act", "fused_fc_act", "fused_chain",
+    "fused_sgd", "fused_momentum", "fused_adam",
+)
+
+# per-param input slots / shared input slots / per-param output slots
+_OPT_SLOTS = {
+    "sgd": (("Param", "Grad"), ("LearningRate",), ("ParamOut",)),
+    "momentum": (("Param", "Grad", "Velocity"), ("LearningRate",),
+                 ("ParamOut", "VelocityOut")),
+    "adam": (("Param", "Grad", "Moment1", "Moment2"),
+             ("LearningRate", "Beta1Pow", "Beta2Pow"),
+             ("ParamOut", "Moment1Out", "Moment2Out")),
+}
+
+
+@dataclass
+class Group:
+    """One fused window: ops[start:end] of the global block, executed as
+    a unit at the anchor index (start)."""
+    kind: str                    # conv_bn_act | bn_act | fc_act | chain | opt_bucket
+    start: int
+    end: int                     # exclusive
+    members: Tuple[Any, ...]     # Operators in block order
+    op: Any = None               # synthetic fused Operator (non-bucket kinds)
+    conv: Any = None
+    bn: Any = None
+    act: Any = None
+    fold: bool = False           # inference-mode BN fold into conv weights
+    elide: Tuple[str, ...] = ()  # names the fold path never materializes
+    cache: Dict[Any, Any] = field(default_factory=dict)
+
+
+# --- plan ---------------------------------------------------------------
+
+_PLANS: Dict[Tuple[int, int], Tuple[Any, Optional[Dict[int, Group]]]] = {}
+
+
+def plan(program) -> Optional[Dict[int, Group]]:
+    """anchor index -> Group for the program's global block, or None when
+    fusion is off / nothing matches. Cached per (id, version) like the
+    executor's jit cache."""
+    if not FUSION_OPT:
+        return None
+    key = (id(program), getattr(program, "_version", 0))
+    hit = _PLANS.get(key)
+    if hit is not None and hit[0] is program:
+        return hit[1]
+    if len(_PLANS) > 64:
+        _PLANS.clear()
+    groups = _build(program.global_block())
+    _PLANS[key] = (program, groups)
+    return groups
+
+
+def _build(block) -> Optional[Dict[int, Group]]:
+    ops = block.ops
+    groups: Dict[int, Group] = {}
+    i, n = 0, len(ops)
+    while i < n:
+        g = (_match_opt_bucket(ops, i) or _match_conv_bn_act(ops, i)
+             or _match_fc_act(ops, i) or _match_chain(ops, i))
+        if g is not None:
+            groups[i] = g
+            i = g.end
+        else:
+            i += 1
+    return groups or None
+
+
+def _first(names: List[str]) -> Optional[str]:
+    return names[0] if names else None
+
+
+def _match_conv_bn_act(ops, i) -> Optional[Group]:
+    n = len(ops)
+    conv = None
+    j = i
+    if ops[j].type in CONV_OPS:
+        conv = ops[j]
+        j += 1
+        if j >= n or ops[j].type != "batch_norm":
+            return None
+    elif ops[j].type != "batch_norm":
+        return None
+    bn = ops[j]
+    if conv is not None and \
+            _first(bn.desc.input("X")) != _first(conv.desc.output("Output")):
+        return None
+    j += 1
+    act = None
+    if j < n and ops[j].type in ACT_OPS and \
+            _first(ops[j].desc.input("X")) == _first(bn.desc.output("Y")):
+        act = ops[j]
+        j += 1
+    if conv is None and act is None:
+        return None   # a bare batch_norm is not a window
+    members = tuple(m for m in (conv, bn, act) if m is not None)
+    fold, elide = False, ()
+    if conv is not None and bn.attr("is_test", False):
+        out_name = _first(conv.desc.output("Output"))
+        fold = _foldable(ops, conv, bn, out_name)
+        if fold:
+            elide = (out_name,)
+    kind = "conv_bn_act" if conv is not None else "bn_act"
+    g = Group(kind=kind, start=i, end=j, members=members,
+              conv=conv, bn=bn, act=act, fold=fold, elide=elide)
+    g.op = _window_synth(
+        members, "fused_conv_bn_act" if conv is not None else "fused_bn_act",
+        g, elide=elide)
+    return g
+
+
+def _foldable(ops, conv, bn, out_name) -> bool:
+    """The conv output can be elided iff bn is its only consumer, no
+    later op rewrites the name, and it isn't persistable state."""
+    if out_name is None:
+        return False
+    for o in ops:
+        if o is bn or o is conv:
+            continue
+        if out_name in o.desc.input_arg_names():
+            return False
+        if out_name in o.desc.output_arg_names():
+            return False
+    block = getattr(conv, "block", None)
+    if block is not None and block.desc.has_var(out_name) and \
+            block.desc.var(out_name).persistable:
+        return False
+    return True
+
+
+def _match_fc_act(ops, i) -> Optional[Group]:
+    n = len(ops)
+    if ops[i].type != "mul" or i + 1 >= n or \
+            ops[i + 1].type != "elementwise_add":
+        return None
+    mul, add = ops[i], ops[i + 1]
+    if _first(add.desc.input("X")) != _first(mul.desc.output("Out")):
+        return None
+    # channel-bias form only: a 1-D Y (plan-time shape from the block)
+    yname = _first(add.desc.input("Y"))
+    block = getattr(mul, "block", None)
+    if yname is None or block is None or not block.desc.has_var(yname):
+        return None
+    yshape = block.desc.var(yname).shape
+    if yshape is None or len(yshape) != 1:
+        return None
+    j = i + 2
+    act = None
+    if j < n and ops[j].type in ACT_OPS and \
+            _first(ops[j].desc.input("X")) == _first(add.desc.output("Out")):
+        act = ops[j]
+        j += 1
+    members = tuple(m for m in (mul, add, act) if m is not None)
+    g = Group(kind="fc_act", start=i, end=j, members=members)
+    g.op = _window_synth(members, "fused_fc_act", g)
+    return g
+
+
+def _chain_ok(op) -> bool:
+    t = op.type
+    base = t[: -len("_grad")] if t.endswith("_grad") else t
+    return base in CHAIN_OPS
+
+
+def _match_chain(ops, i) -> Optional[Group]:
+    n = len(ops)
+    j = i
+    while j < n and _chain_ok(ops[j]):
+        j += 1
+    if j - i < 2:
+        return None
+    members = tuple(ops[i:j])
+    g = Group(kind="chain", start=i, end=j, members=members)
+    g.op = _window_synth(members, "fused_chain", g)
+    return g
+
+
+def _opt_key(op):
+    lr = tuple(op.desc.input("LearningRate"))
+    if op.type == "sgd":
+        return (lr,)
+    if op.type == "momentum":
+        return (lr, op.attr("mu"), bool(op.attr("use_nesterov", False)))
+    return (lr, op.attr("beta1", 0.9), op.attr("beta2", 0.999),
+            op.attr("epsilon", 1e-8), tuple(op.desc.input("Beta1Pow")),
+            tuple(op.desc.input("Beta2Pow")))
+
+
+def _match_opt_bucket(ops, i) -> Optional[Group]:
+    t = ops[i].type
+    if t not in OPTIMIZER_BUCKET_OPS:
+        return None
+    key0 = _opt_key(ops[i])
+    n = len(ops)
+    j = i + 1
+    while j < n and ops[j].type == t and _opt_key(ops[j]) == key0:
+        j += 1
+    if j - i < 2:
+        return None
+    return Group(kind="opt_bucket", start=i, end=j, members=tuple(ops[i:j]))
+
+
+# --- synthetic fused Operators ------------------------------------------
+
+def _synth_operator(block, desc, site):
+    from ..framework.framework import Operator
+    o = Operator.__new__(Operator)   # view pattern, as registry's grad re-trace
+    o.block = block
+    o.desc = desc
+    o.creation_site = site
+    return o
+
+
+def _window_synth(members, type_, group, elide=()):
+    """One fused op spanning the window. Member slots merge under
+    per-member prefixes ("<k>:<slot>") so colliding slot names (bn "X" vs
+    act "X") stay distinct; only EXTERNAL inputs (not produced inside the
+    window) are declared. The compose lowerings read ctx.env directly and
+    ignore the gathered ins."""
+    produced = set()
+    inputs: Dict[str, List[str]] = {}
+    outputs: Dict[str, List[str]] = {}
+    attrs: Dict[str, Any] = {}
+    for k, m in enumerate(members):
+        for slot, names in m.desc.inputs.items():
+            ext = [x for x in names if x not in produced]
+            if ext:
+                inputs[f"{k}:{slot}"] = ext
+        for slot, names in m.desc.outputs.items():
+            keep = [x for x in names if x not in elide]
+            if keep:
+                outputs[f"{k}:{slot}"] = keep
+            produced.update(names)
+        for a, v in m.desc.attrs.items():
+            attrs[f"{k}:{a}"] = v
+    attrs["__fusion_group__"] = group
+    desc = OpDesc(type=type_, inputs=inputs, outputs=outputs, attrs=attrs)
+    return _synth_operator(getattr(members[0], "block", None), desc,
+                           getattr(members[0], "creation_site", None))
+
+
+def _bucket_synth(group, members, t):
+    """Fused optimizer op over a dense same-dtype sub-bucket: slots keep
+    their natural names with one entry per member (uniform across
+    members), shared slots (LR, beta pows) collapse to one."""
+    key = tuple(id(m) for m in members)
+    hit = group.cache.get(key)
+    if hit is not None:
+        return hit
+    per_param, shared, outs = _OPT_SLOTS[t]
+    inputs = {s: [_first(m.desc.input(s)) for m in members]
+              for s in per_param}
+    for s in shared:
+        inputs[s] = list(members[0].desc.input(s))
+    outputs = {s: [_first(m.desc.output(s)) for m in members] for s in outs}
+    attrs = dict(members[0].desc.attrs)
+    attrs["__fusion_group__"] = group
+    desc = OpDesc(type="fused_" + t, inputs=inputs, outputs=outputs,
+                  attrs=attrs)
+    op = _synth_operator(getattr(members[0], "block", None), desc,
+                         getattr(members[0], "creation_site", None))
+    group.cache[key] = op
+    return op
+
+
+# --- execution ----------------------------------------------------------
+
+def _count(ctx, reason: str, amount: int = 1):
+    from .. import telemetry
+    telemetry.counter(
+        "fusion_fallback_total",
+        "ops lowered unfused (or without the fused kernel) by the "
+        "trace-time fusion pass, by reason",
+        labels=("program", "reason")).labels(
+        program=telemetry.program_label(ctx.program), reason=reason).inc(
+        amount)
+
+
+@contextmanager
+def _muted_observers():
+    """Member ops run through the executor's full _exec_op for bitwise
+    parity, but only the FUSED op should reach the cost observers — the
+    device-side HLO attribution keys on the outermost pd.* named scope
+    (xplane.hlo_op_names), so the analytic table must match it."""
+    from .. import executor as executor_mod
+    saved = executor_mod._op_observers
+    executor_mod._op_observers = []
+    try:
+        yield
+    finally:
+        executor_mod._op_observers = saved
+
+
+def execute_group(executor, ctx, group: Group, env, protected=()):
+    """Lower one planned group at its anchor. `protected` (fetch names +
+    persistable outputs) blocks fold-mode elision at trace time — the
+    plan is fetch-agnostic and cached."""
+    if group.kind == "opt_bucket":
+        _execute_opt_bucket(executor, ctx, group, env)
+        return
+    if group.elide and (set(group.elide) & set(protected)):
+        _count(ctx, "fetched_intermediate", len(group.members))
+        for m in group.members:
+            executor._exec_op(ctx, m, env)
+        return
+    executor._exec_op(ctx, group.op, env)
+
+
+def _execute_opt_bucket(executor, ctx, group: Group, env):
+    t = group.members[0].type
+    specs = getattr(ctx.program, "_param_shardings", None) or {}
+    dense: List[Any] = []
+    for m in group.members:
+        gname = _first(m.desc.input("Grad"))
+        pname = _first(m.desc.input("Param"))
+        if isinstance(env.get(gname), SelectedRowsVal):
+            # sparse fast path stays per-param (reference: only a few ops
+            # register SelectedRows kernels; densifying would be O(vocab))
+            _count(ctx, "sparse_grad")
+            executor._exec_op(ctx, m, env)
+        elif pname in specs:
+            # explicitly sharded params stay per-param: concatenating
+            # differently-sharded buffers would force GSPMD gathers
+            _count(ctx, "sharded_param")
+            executor._exec_op(ctx, m, env)
+        else:
+            dense.append(m)
+    # sub-bucket by the trace-time dtypes of every per-param tensor so the
+    # flat concat never promotes (bitwise parity holds per element)
+    per_param = _OPT_SLOTS[t][0]
+    buckets: Dict[Tuple[str, ...], List[Any]] = {}
+    for m in dense:
+        sig = []
+        for s in per_param:
+            if s == "Grad":
+                continue   # grads upcast per-tensor to the param dtype
+            v = env.get(_first(m.desc.input(s)))
+            sig.append(str(getattr(v, "dtype", None)))
+        buckets.setdefault(tuple(sig), []).append(m)
+    for sig in sorted(buckets):
+        ms = buckets[sig]
+        if len(ms) < 2:
+            for m in ms:
+                executor._exec_op(ctx, m, env)
+            continue
+        executor._exec_op(ctx, _bucket_synth(group, ms, t), env)
+
+
+# --- compose machinery --------------------------------------------------
+
+def _out_names(op_) -> List[str]:
+    return [n for ns in op_.desc.outputs.values() for n in ns]
+
+
+def _freeze(ctx, env, names):
+    """After members ran inside a fused lowering, freeze their layout
+    tags and SEQLEN side channels into the OUTER op's override dicts —
+    otherwise the executor's post-op tag_outputs/SEQLEN pass (which only
+    understands the fused op's merged desc) would clobber member-exact
+    state. A None override pops, same as absent."""
+    from .. import executor as executor_mod
+    ctx.layout_overrides = {n: ctx.layouts.get(n) for n in names}
+    seq: Dict[str, Any] = {}
+    for n in names:
+        seq[n] = env.get(n + executor_mod.SEQLEN_SUFFIX)
+        seq[n + executor_mod.SEQLEN2_SUFFIX] = \
+            env.get(n + executor_mod.SEQLEN2_SUFFIX)
+    ctx.seq_overrides = seq
+
+
+def _collect(op_, env):
+    return {slot: [env.get(n) for n in names]
+            for slot, names in op_.desc.outputs.items()}
+
+
+def _compose_lower(ctx, op_, ins):
+    """Generic fused lowering: run every member through the executor's
+    own _exec_op (prepass -> registry lowering -> tag/SEQLEN bookkeeping)
+    under the fused op's named scope — bitwise identical values to the
+    unfused trace, one scope/observer entry for attribution."""
+    g: Group = op_.attr("__fusion_group__")
+    env = ctx.env
+    with _muted_observers():
+        for m in g.members:
+            ctx.executor._exec_op(ctx, m, env)
+    _freeze(ctx, env, _out_names(op_))
+    return _collect(op_, env)
+
+
+# --- conv/bn/act window -------------------------------------------------
+
+def _conv_bn_act_lower(ctx, op_, ins):
+    g: Group = op_.attr("__fusion_group__")
+    env = ctx.env
+    if g.fold:
+        return _fold_lower(ctx, op_, g, env)
+    with _muted_observers():
+        if g.conv is not None:
+            ctx.executor._exec_op(ctx, g.conv, env)
+        reason = _kernel_ineligible(ctx, g, env)
+        if reason is None:
+            _bn_act_pallas(ctx, g, env)
+        else:
+            # compose fallback: still one fused unit for attribution,
+            # but the plain jnp batch_norm (+act) lowerings — bitwise
+            # identical to the unfused trace
+            _count(ctx, reason)
+            ctx.executor._exec_op(ctx, g.bn, env)
+            if g.act is not None:
+                ctx.executor._exec_op(ctx, g.act, env)
+    _freeze(ctx, env, _out_names(op_))
+    return _collect(op_, env)
+
+
+def _kernel_ineligible(ctx, g: Group, env) -> Optional[str]:
+    """None when the Pallas bn+act kernel applies, else a fallback-counter
+    reason. The kernel computes one-pass f32 statistics — exactly the
+    unfused bf16 path — so it is gated to bf16 inputs; f32 inputs keep the
+    two-pass centered variance via the compose fallback."""
+    if g.bn.attr("is_test", False):
+        return "kernel_is_test"
+    xname = _first(g.bn.desc.input("X"))
+    x = env.get(xname)
+    if getattr(x, "ndim", 0) != 4 or \
+            ctx.layouts.get(xname) != layout_mod.NHWC:
+        return "kernel_layout"
+    if getattr(x, "dtype", None) != jnp.bfloat16:
+        return "kernel_dtype"
+    c = x.shape[-1]
+    m = int(np.prod(x.shape[:-1]))
+    if c % 128 != 0 or m < 8 or m % 8 != 0:
+        return "kernel_shape"
+    return None
+
+
+def _bn_act_pallas(ctx, g: Group, env):
+    """Training-mode BN[+act] as one Pallas TPU kernel over the [M, C]
+    view of the NHWC activation (M = N*H*W): a two-phase grid reads each
+    x block twice — phase 0 accumulates per-channel sum/sum-of-squares in
+    VMEM scratch, phase 1 normalizes, applies the activation, and writes
+    the bf16 outputs — so statistics + normalize + activation take two
+    HBM sweeps of x and never materialize f32 intermediates."""
+    bn, act = g.bn, g.act
+    xname = _first(bn.desc.input("X"))
+    x = jnp.asarray(env[xname])
+    scale = jnp.asarray(env[_first(bn.desc.input("Scale"))])
+    bias = jnp.asarray(env[_first(bn.desc.input("Bias"))])
+    mean = jnp.asarray(env[_first(bn.desc.input("Mean"))])
+    var = jnp.asarray(env[_first(bn.desc.input("Variance"))])
+    eps = float(bn.attr("epsilon", 1e-5))
+    momentum = bn.attr("momentum", 0.9)
+    c = x.shape[-1]
+    x2 = x.reshape(-1, c)
+
+    act_fn = None
+    if act is not None:
+        base = _activations[act.type]
+        act_fn = functools.partial(base, a=act)
+    ybn2, yact2, saved_mean, saved_var = _pallas_bn_act(
+        x2, scale.astype(jnp.float32), bias.astype(jnp.float32), eps,
+        act_fn)
+
+    y = ybn2.reshape(x.shape)
+    env[_first(bn.desc.output("Y"))] = y
+    ctx.layouts[_first(bn.desc.output("Y"))] = layout_mod.NHWC
+    # running stats on tiny [C] vectors stay outside the kernel
+    env[_first(bn.desc.output("MeanOut"))] = \
+        mean * momentum + saved_mean * (1.0 - momentum)
+    env[_first(bn.desc.output("VarianceOut"))] = \
+        var * momentum + saved_var * (1.0 - momentum)
+    env[_first(bn.desc.output("SavedMean"))] = saved_mean
+    env[_first(bn.desc.output("SavedVariance"))] = saved_var
+    if act is not None:
+        out = _first(act.desc.output("Out"))
+        env[out] = yact2.reshape(x.shape)
+        ctx.layouts[out] = layout_mod.NHWC
+
+
+def _bn_act_kernel(x_ref, scale_ref, bias_ref, *refs, eps, act, m_total):
+    if act is None:
+        ybn_ref, mean_ref, var_ref, sum_ref, sq_ref = refs
+        yact_ref = None
+    else:
+        ybn_ref, yact_ref, mean_ref, var_ref, sum_ref, sq_ref = refs
+    from jax.experimental import pallas as pl
+    p = pl.program_id(1)
+    m = pl.program_id(2)
+
+    @pl.when(jnp.logical_and(p == 0, m == 0))
+    def _zero():
+        sum_ref[...] = jnp.zeros(sum_ref.shape, jnp.float32)
+        sq_ref[...] = jnp.zeros(sq_ref.shape, jnp.float32)
+
+    @pl.when(p == 0)
+    def _accumulate():
+        xb = x_ref[...].astype(jnp.float32)
+        sum_ref[...] += jnp.sum(xb, axis=0, keepdims=True)
+        sq_ref[...] += jnp.sum(xb * xb, axis=0, keepdims=True)
+
+    @pl.when(p == 1)
+    def _apply():
+        mean = sum_ref[...] / m_total
+        # one-pass variance, clamped like the unfused bf16 batch_norm
+        varv = jnp.maximum(sq_ref[...] / m_total - mean * mean, 0.0)
+
+        @pl.when(m == 0)
+        def _stats():
+            mean_ref[...] = mean
+            var_ref[...] = varv
+
+        inv = jax.lax.rsqrt(varv + eps)
+        xb = x_ref[...].astype(jnp.float32)
+        y = (xb - mean) * (inv * scale_ref[...]) + bias_ref[...]
+        y = y.astype(ybn_ref.dtype)
+        ybn_ref[...] = y
+        if yact_ref is not None:
+            yact_ref[...] = act(y)
+
+
+def _pallas_bn_act(x2, scale, bias, eps, act_fn):
+    """x2: [M, C] bf16 (C % 128 == 0, M % 8 == 0). Returns (ybn, yact,
+    mean, var) with yact None-shaped out when act_fn is None."""
+    from jax.experimental import pallas as pl
+    from .pallas_attention import _compiler_params, _interpret, _scratch
+    m_total, c = x2.shape
+    bc = 128
+    bm = next(b for b in (512, 256, 128, 64, 32, 16, 8) if m_total % b == 0)
+    grid = (c // bc, 2, m_total // bm)
+
+    x_spec = pl.BlockSpec((bm, bc), lambda cc, p, mm: (mm, cc))
+    vec_spec = pl.BlockSpec((1, bc), lambda cc, p, mm: (0, cc))
+    out_specs = [x_spec] + ([x_spec] if act_fn is not None else []) + \
+        [vec_spec, vec_spec]
+    out_shape = [jax.ShapeDtypeStruct((m_total, c), x2.dtype)]
+    if act_fn is not None:
+        out_shape.append(jax.ShapeDtypeStruct((m_total, c), x2.dtype))
+    out_shape += [jax.ShapeDtypeStruct((1, c), jnp.float32),
+                  jax.ShapeDtypeStruct((1, c), jnp.float32)]
+
+    kernel = functools.partial(_bn_act_kernel, eps=eps, act=act_fn,
+                               m_total=float(m_total))
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[x_spec, vec_spec, vec_spec],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[_scratch((1, bc)), _scratch((1, bc))],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(
+            ("parallel", "arbitrary", "arbitrary")),
+    )(x2, scale.reshape(1, c), bias.reshape(1, c))
+    if act_fn is not None:
+        ybn, yact, mean, var = outs
+    else:
+        ybn, mean, var = outs
+        yact = None
+    return ybn, yact, mean.reshape(c), var.reshape(c)
+
+
+def _fold_lower(ctx, op_, g: Group, env):
+    """Inference-mode conv+bn[+act]: BN folds into the conv filter and a
+    channel bias — y = conv(x, w * s) + (bias - mean * s) with
+    s = scale/sqrt(var+eps) — and the conv's own output is never
+    materialized (the plan guaranteed bn is its only consumer). The
+    folded filter goes through the REGISTERED conv lowering (a view of
+    the conv op whose Output name is bn's Y), so NHWC layout handling and
+    AMP casts stay identical."""
+    from .registry import get as reg_get
+    conv, bn, act = g.conv, g.bn, g.act
+    w = jnp.asarray(env[_first(conv.desc.input("Filter"))])
+    scale = jnp.asarray(env[_first(bn.desc.input("Scale"))]).astype(
+        jnp.float32)
+    bias = jnp.asarray(env[_first(bn.desc.input("Bias"))]).astype(
+        jnp.float32)
+    mean = jnp.asarray(env[_first(bn.desc.input("Mean"))]).astype(
+        jnp.float32)
+    var = jnp.asarray(env[_first(bn.desc.input("Variance"))]).astype(
+        jnp.float32)
+    eps = bn.attr("epsilon", 1e-5)
+    s = scale * jax.lax.rsqrt(var + eps)
+    # OIHW filter: fold scales the output-channel dim (groups included)
+    wf = (w.astype(jnp.float32) * s.reshape((-1,) + (1,) * (w.ndim - 1))
+          ).astype(w.dtype)
+    bf = bias - mean * s
+
+    y_name = _first(bn.desc.output("Y"))
+    view_desc = OpDesc(type=conv.type, inputs=dict(conv.desc.inputs),
+                       outputs={"Output": [y_name]},
+                       attrs=dict(conv.desc.attrs))
+    conv_view = _synth_operator(getattr(conv, "block", None), view_desc,
+                                getattr(conv, "creation_site", None))
+    conv_ins = {slot: [env.get(n) for n in names]
+                for slot, names in conv.desc.inputs.items()}
+    conv_ins["Filter"] = [wf]
+    y = reg_get(conv.type).lower(ctx, conv_view, conv_ins)["Output"][0]
+    tag = ctx.layout_overrides.get(y_name)
+    bfc = bf.astype(y.dtype)   # AMP O2: keep bf16 activations bf16
+    if tag is not None:
+        y = y + bfc.reshape((1,) * (y.ndim - 1) + (-1,))
+    else:
+        y = y + bfc.reshape((1, -1) + (1,) * (y.ndim - 2))
+    env[y_name] = y
+    if tag is not None:
+        ctx.layouts[y_name] = tag
+    # is_test BN passes running stats through all four stat outputs
+    env[_first(bn.desc.output("MeanOut"))] = env[_first(bn.desc.input("Mean"))]
+    env[_first(bn.desc.output("VarianceOut"))] = \
+        env[_first(bn.desc.input("Variance"))]
+    env[_first(bn.desc.output("SavedMean"))] = \
+        env[_first(bn.desc.input("Mean"))]
+    env[_first(bn.desc.output("SavedVariance"))] = \
+        env[_first(bn.desc.input("Variance"))]
+    if act is not None:
+        out = _first(act.desc.output("Out"))
+        env[out] = _activations[act.type](y, act)
+        if tag is not None:
+            ctx.layouts[out] = tag
+    _freeze(ctx, env, _out_names(op_))
+    return _collect(op_, env)
+
+
+# --- bucketed optimizer lowerings ---------------------------------------
+
+def _flat_params_grads(ins):
+    ps = [jnp.asarray(v) for v in ins["Param"]]
+    shapes = [p.shape for p in ps]
+    # per-tensor upcast BEFORE the concat — exactly _param_grad per member
+    gs = [jnp.asarray(maybe_dense(gv)).astype(p.dtype)
+          for p, gv in zip(ps, ins["Grad"])]
+    return _cat(ps), _cat(gs), shapes
+
+
+def _cat(vals):
+    flats = [jnp.asarray(v).ravel() for v in vals]
+    return flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+
+
+def _split(flat, shapes):
+    out = []
+    off = 0
+    for s in shapes:
+        n = int(np.prod(s)) if len(s) else 1
+        out.append(flat[off:off + n].reshape(s))
+        off += n
+    return out
+
+
+def _lower_fused_sgd(ctx, op_, ins):
+    p, grad, shapes = _flat_params_grads(ins)
+    po = optimizer_ops.sgd_dense(p, grad, optimizer_ops._lr(ins))
+    return {"ParamOut": _split(po, shapes)}
+
+
+def _lower_fused_momentum(ctx, op_, ins):
+    p, grad, shapes = _flat_params_grads(ins)
+    v = _cat(ins["Velocity"])
+    po, vo = optimizer_ops.momentum_dense(
+        p, grad, v, optimizer_ops._lr(ins), op_.attr("mu"),
+        op_.attr("use_nesterov", False))
+    return {"ParamOut": _split(po, shapes),
+            "VelocityOut": _split(vo, shapes)}
+
+
+def _lower_fused_adam(ctx, op_, ins):
+    p, grad, shapes = _flat_params_grads(ins)
+    m1 = _cat(ins["Moment1"])
+    m2 = _cat(ins["Moment2"])
+    b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
+    b2p = jnp.asarray(ins["Beta2Pow"][0]).reshape(())
+    po, m1o, m2o = optimizer_ops.adam_dense(
+        p, grad, m1, m2, optimizer_ops._lr(ins), op_.attr("beta1", 0.9),
+        op_.attr("beta2", 0.999), op_.attr("epsilon", 1e-8), b1p, b2p)
+    return {"ParamOut": _split(po, shapes),
+            "Moment1Out": _split(m1o, shapes),
+            "Moment2Out": _split(m2o, shapes)}
+
+
+# --- registration -------------------------------------------------------
+
+register("fused_conv_bn_act", lower=_conv_bn_act_lower, grad=NO_GRAD)
+register("fused_bn_act", lower=_conv_bn_act_lower, grad=NO_GRAD)
+register("fused_fc_act", lower=_compose_lower, grad=NO_GRAD)
+register("fused_chain", lower=_compose_lower, grad=NO_GRAD)
+register("fused_sgd", lower=_lower_fused_sgd, grad=NO_GRAD)
+register("fused_momentum", lower=_lower_fused_momentum, grad=NO_GRAD)
+register("fused_adam", lower=_lower_fused_adam, grad=NO_GRAD)
+
+# fused ops manage layout tags themselves (member-level prepass/
+# tag_outputs run inside the lowerings); without this the executor's
+# prepass would barrier-canonicalize every tagged input of the window
+layout_mod.AWARE_OPS.update(FUSED_OP_TYPES)

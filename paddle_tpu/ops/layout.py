@@ -34,7 +34,7 @@ NDHWC = "NDHWC"    # 5-D volumetric activations
 # ops whose lowerings read/write layout tags themselves
 AWARE_OPS = {
     "conv2d", "depthwise_conv2d", "conv2d_transpose", "conv3d",
-    "batch_norm", "pool2d",
+    "batch_norm", "pool2d", "prelu",
 }
 
 # elementwise ops that preserve layout: values pass through untouched and
@@ -45,8 +45,6 @@ AGNOSTIC_OPS = {
     "square", "sqrt", "exp", "log", "clip", "scale", "cast", "dropout",
     "dropout_grad", "pow", "softsign", "softplus", "round", "floor",
     "ceil", "hard_sigmoid", "brelu", "soft_relu", "swish",
-    # NOT prelu: its channel/element modes reshape alpha assuming NCHW
-    # (vision_ops.py), so it must see canonical layout
     "sum", "elementwise_add", "elementwise_sub", "elementwise_mul",
     "elementwise_div", "elementwise_max", "elementwise_min",
 }
@@ -91,6 +89,9 @@ def _aware_retrace_tag(base, op, layouts):
     if base == "batch_norm":
         t = layouts.get(op.desc.inputs.get("X", [""])[0])
         return "Y", t if t in (NHWC, NDHWC) else None
+    if base == "prelu":
+        t = layouts.get(op.desc.inputs.get("X", [""])[0])
+        return "Out", t if t in (NHWC, NDHWC) else None
     return None, None
 
 

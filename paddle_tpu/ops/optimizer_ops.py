@@ -40,6 +40,33 @@ def _param_grad(ins):
     return p, jnp.asarray(maybe_dense(ins["Grad"][0])).astype(p.dtype)
 
 
+# Dense update math, shared by the per-param lowerings below and the
+# bucketed fused apply (ops/fusion.py). Purely elementwise over
+# (param, grad, accumulators) with scalar hyperparameters, so applying
+# one expression to a concatenation of flattened tensors is bitwise
+# identical to applying it per tensor — the property the fused optimizer
+# parity tests pin down.
+
+def sgd_dense(p, g, lr):
+    return p - lr * g
+
+
+def momentum_dense(p, g, v, lr, mu, use_nesterov):
+    v_out = mu * v + g
+    if use_nesterov:
+        p_out = p - lr * (g + mu * v_out)
+    else:
+        p_out = p - lr * v_out
+    return p_out, v_out
+
+
+def adam_dense(p, g, m1, m2, lr, b1, b2, eps, b1p, b2p):
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    po = p - lr_t * m1o / (jnp.sqrt(m2o) + eps)
+    return po, m1o, m2o
+
 
 @op("sgd", grad=NO_GRAD, infer_shape=_param_out_infer(("Param", "ParamOut")))
 def _sgd(ctx, op_, ins):
@@ -51,7 +78,7 @@ def _sgd(ctx, op_, ins):
         upd = (-_lr(ins) * g0.values).astype(p.dtype)
         return {"ParamOut": [p.at[g0.rows].add(upd)]}
     p, g = _param_grad(ins)
-    return {"ParamOut": [p - _lr(ins) * g]}
+    return {"ParamOut": [sgd_dense(p, g, _lr(ins))]}
 
 
 @op("momentum", grad=NO_GRAD,
@@ -76,11 +103,8 @@ def _momentum(ctx, op_, ins):
                 "VelocityOut": [v.at[rows].set(v_out, mode="drop")]}
     p, g = _param_grad(ins)
     v = jnp.asarray(ins["Velocity"][0])
-    v_out = mu * v + g
-    if op_.attr("use_nesterov", False):
-        p_out = p - _lr(ins) * (g + mu * v_out)
-    else:
-        p_out = p - _lr(ins) * v_out
+    p_out, v_out = momentum_dense(p, g, v, _lr(ins), mu,
+                                  op_.attr("use_nesterov", False))
     return {"ParamOut": [p_out], "VelocityOut": [v_out]}
 
 
@@ -118,10 +142,8 @@ def _adam(ctx, op_, ins):
     p, g = _param_grad(ins)
     m1 = jnp.asarray(ins["Moment1"][0])
     m2 = jnp.asarray(ins["Moment2"][0])
-    m1o = b1 * m1 + (1 - b1) * g
-    m2o = b2 * m2 + (1 - b2) * g * g
-    lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
-    po = p - lr * m1o / (jnp.sqrt(m2o) + eps)
+    po, m1o, m2o = adam_dense(p, g, m1, m2, _lr(ins), b1, b2, eps,
+                              b1p, b2p)
     return {"ParamOut": [po], "Moment1Out": [m1o], "Moment2Out": [m2o]}
 
 

@@ -418,17 +418,36 @@ def _conv3d_transpose(ctx, op_, ins):
 @op("prelu", infer_shape=same_as_input())
 def _prelu(ctx, op_, ins):
     """Parametric ReLU (reference prelu_op.cc): modes all (one alpha),
-    channel (per-C), element (per-element)."""
+    channel (per-C), element (per-element). Layout-aware: when X carries
+    an NHWC/NDHWC tag the alpha broadcast targets the minor channel axis
+    instead of forcing a canonicalization barrier mid-ResNet-block (alpha
+    itself is stored in canonical [.., C, *spatial] order)."""
+    from . import layout as layout_mod
+
     x = jnp.asarray(ins["X"][0])
     alpha = jnp.asarray(ins["Alpha"][0])
     mode = op_.attr("mode", "all")
+    tag = ctx.layout_of(op_.desc.inputs["X"][0])
+    tagged = (tag in (layout_mod.NHWC, layout_mod.NDHWC)
+              and x.ndim == layout_mod.tag_rank(tag))
     if mode == "all":
         a = alpha.reshape(())
     elif mode == "channel":
-        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+        if tagged:
+            a = alpha.reshape((1,) * (x.ndim - 1) + (-1,))
+        else:
+            a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
     else:
-        # element mode: alpha is [1, *feature_dims], broadcast over batch
-        a = alpha.reshape((1,) + tuple(x.shape[1:]))
+        # element mode: alpha is [1, *canonical_feature_dims] (C first),
+        # broadcast over batch
+        if tagged:
+            a = jnp.moveaxis(
+                alpha.reshape((1, x.shape[-1]) + tuple(x.shape[1:-1])),
+                1, -1)
+        else:
+            a = alpha.reshape((1,) + tuple(x.shape[1:]))
+    if tagged and ctx.layout_opt:
+        ctx.set_layout(op_.desc.outputs["Out"][0], tag)
     return {"Out": [jnp.where(x > 0, x, a * x)]}
 
 

@@ -36,6 +36,7 @@ and `device_duty_cycle` gauges through telemetry.py. Consumers:
 from __future__ import annotations
 
 import os
+import re
 import shutil
 import tempfile
 import time
@@ -43,10 +44,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["program_cost", "op_cost", "matmul_probe", "hbm_probe",
-           "ensure_probes", "nominal_tflops", "collect_report",
-           "format_report", "capture", "waterfall", "top_ops",
-           "UNATTRIBUTED"]
+__all__ = ["program_cost", "op_cost", "hlo_counts", "matmul_probe",
+           "hbm_probe", "ensure_probes", "nominal_tflops",
+           "collect_report", "format_report", "capture", "waterfall",
+           "top_ops", "UNATTRIBUTED"]
 
 UNATTRIBUTED = "(unattributed)"
 
@@ -81,6 +82,24 @@ def _slot_shape(slot_dict, slot) -> Optional[tuple]:
     return None
 
 
+def _suffix_shape(slot_dict, suffix) -> Optional[tuple]:
+    """First concrete shape whose slot is `suffix` or ends in `:suffix` —
+    fused window ops prefix member slots as "<idx>:<slot>"."""
+    for slot in (slot_dict or {}):
+        if slot == suffix or slot.endswith(":" + suffix):
+            s = _slot_shape(slot_dict, slot)
+            if s is not None:
+                return s
+    return None
+
+
+def _suffix_attr(attrs, suffix, default=None):
+    for k, v in (attrs or {}).items():
+        if k == suffix or k.endswith(":" + suffix):
+            return v
+    return default
+
+
 def _bytes_of(avals) -> int:
     total = 0
     for shape, dtype in avals:
@@ -102,6 +121,53 @@ _ELEMWISE_COST = {
     "cross_entropy": 4.0, "softmax_with_cross_entropy": 8.0,
 }
 
+# flops per parameter element for the bucketed fused optimizer applies
+# (ops/fusion.py): sgd = mul+sub; momentum adds the velocity update;
+# adam adds two moment EMAs, the bias-corrected lr and the rsqrt-divide.
+_FUSED_OPT_COST = {"fused_sgd": 2.0, "fused_momentum": 5.0,
+                   "fused_adam": 12.0}
+
+
+def _fused_cost(op_type: str, ins, outs, attrs) -> Tuple[float, float]:
+    """Cost of a fused window/bucket op (ops/fusion.py). Window ops carry
+    member slots prefixed "<idx>:<slot>" and member attrs prefixed
+    "<idx>:<attr>"; optimizer buckets use natural multi-value slots."""
+    in_avals = _aval_list(ins)
+    out_avals = _aval_list(outs)
+    bytes_ = float(_bytes_of(in_avals) + _bytes_of(out_avals))
+    out_elems = sum(_nelems(s) for s, _ in out_avals)
+    in_elems = sum(_nelems(s) for s, _ in in_avals)
+    if op_type == "fused_conv_bn_act":
+        filt = _suffix_shape(ins, "Filter")
+        y = _suffix_shape(outs, "Y") or _suffix_shape(outs, "Output")
+        if filt is not None and y is not None:
+            flops = (2.0 * _nelems(y) * filt[1] * filt[-2] * filt[-1]
+                     + 10.0 * _nelems(y))     # bn stats + normalize + act
+        else:
+            flops = float(out_elems)
+    elif op_type == "fused_bn_act":
+        y = _suffix_shape(outs, "Y")
+        flops = 6.0 * float(_nelems(y) if y is not None
+                            else max(in_elems, out_elems))
+    elif op_type in _FUSED_OPT_COST:
+        p_elems = sum(_nelems(getattr(v, "shape", ()))
+                      for v in (ins or {}).get("Param", [])
+                      if getattr(v, "shape", None) is not None)
+        flops = _FUSED_OPT_COST[op_type] * float(p_elems or out_elems)
+    else:
+        # fused_fc_act (matmul + bias + act) or fused_chain (one-ish flop
+        # per produced element; XLA DCEs the unread member outputs)
+        x = _suffix_shape(ins, "X")
+        out_shape = _suffix_shape(outs, "Out")
+        ncol = int(_suffix_attr(attrs, "x_num_col_dims", 1) or 1)
+        if op_type == "fused_fc_act" and x is not None \
+                and out_shape is not None:
+            flops = (2.0 * _nelems(out_shape) * _nelems(x[ncol:])
+                     + 2.0 * _nelems(out_shape))
+        else:
+            flops = float(out_elems)
+    return flops, bytes_
+
 
 def op_cost(op_type: str, ins: Dict[str, list], outs: Dict[str, list],
             attrs=None) -> Tuple[float, float]:
@@ -110,6 +176,8 @@ def op_cost(op_type: str, ins: Dict[str, list], outs: Dict[str, list],
     output written once (XLA fusion only shrinks this, so intensity is a
     floor and the memory-bound verdict conservative)."""
     attrs = attrs or {}
+    if op_type.startswith("fused_"):
+        return _fused_cost(op_type, ins, outs, attrs)
     in_avals = _aval_list(ins)
     out_avals = _aval_list(outs)
     bytes_ = float(_bytes_of(in_avals) + _bytes_of(out_avals))
@@ -217,6 +285,27 @@ def program_cost(executor, program, feed_avals: Dict[str, Any],
     return {"ops": table,
             "total_flops": sum(d["flops"] for d in table.values()),
             "total_bytes": sum(d["bytes"] for d in table.values())}
+
+
+# --- HLO instruction / kernel counts ----------------------------------------
+
+# one HLO instruction per "name = <shape> opcode(...)" line; tuple shapes
+# contain no nested parens so the alternation stays regular
+_HLO_INSTR = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.-]+\s*=\s*(?:\([^)]*\)|\S+)\s+([\w-]+)\(",
+    re.M)
+
+
+def hlo_counts(hlo_text: str) -> Dict[str, int]:
+    """{"instructions", "fusions"} for one compiled module's HLO text —
+    the per-step kernel-count proxy the fusion pass is judged by: fewer
+    instructions/fusions at equal math means the trace handed XLA larger
+    windows. Counts every instruction line incl. fused computations'
+    bodies; "fusions" counts the top-level fusion ops (≈ device kernels
+    that aren't library calls)."""
+    ops = _HLO_INSTR.findall(hlo_text or "")
+    return {"instructions": len(ops),
+            "fusions": sum(1 for o in ops if o == "fusion")}
 
 
 # --- two-point measured roofline --------------------------------------------
@@ -430,6 +519,7 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
     total_flops = total_bytes = 0.0
     xla_flops = 0.0
     have_cost = have_xla = False
+    hlo = {"modules": 0, "instructions": 0, "fusions": 0}
     notes: List[str] = []
     for pair in suppliers:
         supply, cost_fn = pair if isinstance(pair, tuple) else (pair, None)
@@ -438,6 +528,10 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
             text = compiled if isinstance(compiled, str) \
                 else compiled.as_text()
             mapping.update(xplane.hlo_op_names(text))
+            counts = hlo_counts(text)
+            hlo["modules"] += 1
+            hlo["instructions"] += counts["instructions"]
+            hlo["fusions"] += counts["fusions"]
             if not isinstance(compiled, str):
                 try:
                     ca = compiled.cost_analysis()
@@ -512,6 +606,7 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
         "ridge_intensity": ridge, "nominal_tflops": nominal,
         "total_flops_per_step": total_flops if have_cost else None,
         "total_bytes_per_step": total_bytes if have_cost else None,
+        "hlo_counts": hlo if hlo["modules"] else None,
         "mfu_nominal": None, "mfu_vs_sustained": None, "notes": notes,
     }
     if have_cost and have_xla and xla_flops > 0:
@@ -578,6 +673,11 @@ def format_report(report: Dict[str, Any]) -> List[str]:
                 _fmt(report.get("sustained_tflops"), width=1),
                 _fmt(report.get("hbm_gbps"), width=1),
                 _fmt(ridge, 1.0, 1, 1)))
+    hc = report.get("hlo_counts")
+    if hc:
+        lines.append(
+            "[hlo] {} instructions | {} fusion kernels | {} modules"
+            .format(hc["instructions"], hc["fusions"], hc["modules"]))
     cc = report.get("cost_crosscheck")
     if cc:
         lines.append(

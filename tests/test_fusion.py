@@ -1,0 +1,398 @@
+"""Trace-time kernel fusion (ops/fusion.py, ISSUE 7): numeric parity
+with the unfused per-op trace, per-reason fallback counters, and the
+PADDLE_TPU_FUSION=0 escape hatch.
+
+The fusion pass has three value-rewriting paths (inference BN fold,
+the Pallas bn+act kernel, bucketed optimizer applies); everything else
+composes the registered member lowerings and must therefore be BITWISE
+identical to the unfused trace — these tests pin exactly that: bitwise
+asserts for compose/bucket paths, tolerance asserts only where the
+rewrite legitimately reassociates float math (BN fold).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as em
+from paddle_tpu import telemetry
+from paddle_tpu.framework import unique_name
+from paddle_tpu.ops import fusion as fusion_mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _with_fusion(fuse, fn, *args, **kw):
+    """Run fn under FUSION_OPT=fuse. Callers build a FRESH program inside
+    fn — the jit and plan caches key on program identity."""
+    old = fusion_mod.FUSION_OPT
+    fusion_mod.FUSION_OPT = fuse
+    try:
+        return fn(*args, **kw)
+    finally:
+        fusion_mod.FUSION_OPT = old
+
+
+def _fallbacks(reason=None):
+    series = telemetry.read_series("fusion_fallback_total")
+    if reason is None:
+        return sum(series.values())
+    return sum(v for k, v in series.items() if f"reason={reason}" in k)
+
+
+def _state(scope):
+    return {n: np.asarray(scope.find_var(n))
+            for n in scope.local_var_names()
+            if isinstance(scope.find_var(n), np.ndarray)
+            or hasattr(scope.find_var(n), "dtype")}
+
+
+def _assert_state_equal(a, b):
+    assert set(a) == set(b), set(a) ^ set(b)
+    for n in sorted(a):
+        np.testing.assert_array_equal(np.asarray(a[n]), np.asarray(b[n]),
+                                      err_msg=f"state '{n}' diverged")
+
+
+def _train_convnet(opt_factory, steps=3):
+    """conv+bn(relu)+pool + an elementwise chain + two fc layers + an
+    optimizer: one program that plans conv_bn_act, chain, fc_act and
+    opt_bucket windows at once."""
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                                padding=1, bias_attr=False)
+        b = fluid.layers.batch_norm(input=c, act="relu")
+        p = fluid.layers.pool2d(input=b, pool_size=2, pool_stride=2)
+        s = fluid.layers.abs(fluid.layers.scale(p, scale=1.5))  # chain
+        gp = fluid.layers.pool2d(input=s, global_pooling=True,
+                                 pool_type="avg")
+        h = fluid.layers.fc(input=gp, size=16, act="relu")      # fc_act
+        logits = fluid.layers.fc(input=h, size=5)               # fc, no act
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        opt_factory().minimize(loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.default_rng(5)
+    scope = em.Scope()
+    losses = []
+    with em.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            x = rng.standard_normal((4, 3, 8, 8)).astype(np.float32)
+            y = rng.integers(0, 5, (4, 1)).astype(np.int64)
+            out, = exe.run(main, feed={"img": x, "label": y},
+                           fetch_list=[loss])
+            losses.append(float(np.ravel(out)[0]))
+        state = _state(scope)
+    return losses, state
+
+
+@pytest.mark.parametrize("opt", ["sgd", "momentum", "adam"])
+def test_training_parity_bitwise(opt):
+    """Fused trace (conv+bn+act compose, chain, fc windows, bucketed
+    optimizer) is bitwise identical to the unfused per-op trace."""
+    factory = {
+        "sgd": lambda: fluid.optimizer.SGD(learning_rate=0.05),
+        "momentum": lambda: fluid.optimizer.Momentum(learning_rate=0.05,
+                                                     momentum=0.9),
+        "adam": lambda: fluid.optimizer.Adam(learning_rate=0.01),
+    }[opt]
+    l1, s1 = _with_fusion(True, _train_convnet, factory)
+    l0, s0 = _with_fusion(False, _train_convnet, factory)
+    assert l1 == l0
+    _assert_state_equal(s1, s0)
+
+
+def test_kernel_gate_counts_f32_fallback():
+    """f32 training bn+act is outside the Pallas kernel's envelope (the
+    kernel mirrors the bf16 one-pass stats); the group must still fuse
+    via compose and count one per-reason fallback per trace."""
+    before = _fallbacks("kernel_dtype")
+    _with_fusion(True, _train_convnet,
+                 lambda: fluid.optimizer.SGD(learning_rate=0.05))
+    assert _fallbacks("kernel_dtype") > before
+
+
+def _bn_act_net(steps=2):
+    """batch_norm(act) directly on the feed — the conv-less bn_act window
+    — trained with SGD so the bn scale/bias pair exercises a 2-param
+    fused_sgd bucket."""
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6, 4, 4], dtype="float32")
+        b = fluid.layers.batch_norm(input=x, act="relu")
+        loss = fluid.layers.mean(b)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.default_rng(7)
+    scope = em.Scope()
+    losses = []
+    with em.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            xv = rng.standard_normal((4, 6, 4, 4)).astype(np.float32)
+            out, = exe.run(main, feed={"x": xv}, fetch_list=[loss])
+            losses.append(float(np.ravel(out)[0]))
+        state = _state(scope)
+    return losses, state
+
+
+def test_bn_act_without_conv_parity():
+    l1, s1 = _with_fusion(True, _bn_act_net)
+    l0, s0 = _with_fusion(False, _bn_act_net)
+    assert l1 == l0
+    _assert_state_equal(s1, s0)
+
+
+def _infer_conv_bn(fetch_inter=False):
+    """Inference-mode conv+bn(relu): the BN-fold path (or its
+    fetched-intermediate fallback when the conv activation is fetched)."""
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 31
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        # bias_attr=False: a conv bias would interpose an elementwise_add
+        # between conv and bn and break the window (and thus the fold)
+        c = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                                padding=1, bias_attr=False)
+        b = fluid.layers.batch_norm(input=c, act="relu", is_test=True)
+        out = fluid.layers.pool2d(input=b, global_pooling=True,
+                                  pool_type="avg")
+    exe = fluid.Executor(fluid.CPUPlace())
+    x = np.random.default_rng(9).standard_normal((2, 3, 8, 8)) \
+        .astype(np.float32)
+    with em.scope_guard(em.Scope()):
+        exe.run(startup)
+        fetch = [out] + ([c] if fetch_inter else [])
+        res = exe.run(main, feed={"img": x}, fetch_list=fetch)
+    return [np.asarray(r) for r in res]
+
+
+def test_bn_fold_inference_parity():
+    """Folding BN into the conv weights reassociates float math — close,
+    not bitwise."""
+    got, = _with_fusion(True, _infer_conv_bn)
+    ref, = _with_fusion(False, _infer_conv_bn)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fold_blocked_by_intermediate_fetch():
+    """Fetching the conv activation protects it: the fold (which never
+    materializes that tensor) must fall back to per-member execution —
+    bitwise vs unfused — and count fetched_intermediate."""
+    before = _fallbacks("fetched_intermediate")
+    got = _with_fusion(True, _infer_conv_bn, fetch_inter=True)
+    assert _fallbacks("fetched_intermediate") > before
+    ref = _with_fusion(False, _infer_conv_bn, fetch_inter=True)
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(g, r)
+
+
+def _sparse_emb_net(steps=3):
+    """is_sparse embedding under Adam: the SelectedRows grad keeps the
+    per-param fast path (reason sparse_grad) while the dense fc pair
+    still buckets."""
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[4], dtype="int64")
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[50, 8], is_sparse=True,
+            param_attr=fluid.ParamAttr(name="emb_w"))
+        flat = fluid.layers.reshape(emb, shape=[-1, 32])
+        logits = fluid.layers.fc(input=flat, size=50)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, lbl))
+        fluid.optimizer.Adam(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = em.Scope()
+    feed = {"ids": np.array([[1, 7, 7, 3], [0, 2, 2, 2]], np.int64),
+            "lbl": np.array([[5], [9]], np.int64)}
+    losses = []
+    with em.scope_guard(scope):
+        exe.run(startup)
+        scope.set_var("emb_w", np.linspace(
+            -1, 1, 50 * 8).astype(np.float32).reshape(50, 8))
+        for _ in range(steps):
+            v, = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.ravel(v)[0]))
+        state = _state(scope)
+    return losses, state
+
+
+def test_sparse_grad_keeps_per_param_path():
+    before = _fallbacks("sparse_grad")
+    l1, s1 = _with_fusion(True, _sparse_emb_net)
+    assert _fallbacks("sparse_grad") > before
+    l0, s0 = _with_fusion(False, _sparse_emb_net)
+    assert l1 == l0
+    _assert_state_equal(s1, s0)
+
+
+def _run_steps_window(steps=3):
+    """K-step run_steps window (lax.scan carries + donation) over the
+    fused trace."""
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        b = fluid.layers.batch_norm(input=c, act="relu")
+        gp = fluid.layers.pool2d(input=b, global_pooling=True,
+                                 pool_type="avg")
+        logits = fluid.layers.fc(input=gp, size=5)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(
+            loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.default_rng(23)
+    feeds = [{"img": rng.standard_normal((4, 3, 8, 8)).astype(np.float32),
+              "label": rng.integers(0, 5, (4, 1)).astype(np.int64)}
+             for _ in range(steps)]
+    scope = em.Scope()
+    with em.scope_guard(scope):
+        exe.run(startup)
+        win, = exe.run_steps(main, feed_window=feeds, fetch_list=[loss],
+                             fetch_mode="stack")
+        state = _state(scope)
+    return np.asarray(win), state
+
+
+def test_run_steps_window_parity():
+    w1, s1 = _with_fusion(True, _run_steps_window)
+    w0, s0 = _with_fusion(False, _run_steps_window)
+    np.testing.assert_array_equal(w1, w0)
+    _assert_state_equal(s1, s0)
+
+
+def test_pallas_bn_act_kernel_parity():
+    """The fused bn+act Pallas kernel (interpret mode off-TPU) matches
+    the unfused bf16 one-pass batch_norm math exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 4, 4, 128)),
+                    dtype=jnp.bfloat16).reshape(-1, 128)
+    scale = jnp.asarray(rng.standard_normal(128), dtype=jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(128), dtype=jnp.float32)
+    eps = 1e-5
+
+    xf = x.astype(jnp.float32)
+    m_ref = jnp.mean(xf, axis=0)
+    v_ref = jnp.maximum(
+        jnp.mean(jnp.square(xf), axis=0) - jnp.square(m_ref), 0.0)
+    inv = jax.lax.rsqrt(v_ref + eps)
+    y_ref = ((xf - m_ref) * (inv * scale) + bias).astype(x.dtype)
+
+    for act_fn in (None, lambda v, a=None: jnp.maximum(v, 0)):
+        res = fusion_mod._pallas_bn_act(x, scale, bias, eps, act_fn)
+        ybn, mean, var = res[0], res[-2], res[-1]
+        np.testing.assert_array_equal(np.asarray(mean), np.asarray(m_ref))
+        np.testing.assert_array_equal(np.asarray(var), np.asarray(v_ref))
+        np.testing.assert_array_equal(
+            np.asarray(ybn.astype(jnp.float32)),
+            np.asarray(y_ref.astype(jnp.float32)))
+        if act_fn is not None:
+            yact = res[1]
+            np.testing.assert_array_equal(
+                np.asarray(yact), np.asarray(jnp.maximum(ybn, 0)))
+
+
+def test_roofline_sees_fused_ops():
+    """The analytic cost model prices fused types from their prefixed
+    member slots, and hlo_counts parses instruction/fusion counts."""
+    import jax
+    from paddle_tpu import roofline
+
+    aval = jax.ShapeDtypeStruct((2, 8, 8, 8), np.float32)
+    filt = jax.ShapeDtypeStruct((8, 3, 3, 3), np.float32)
+    flops, bytes_ = roofline.op_cost(
+        "fused_conv_bn_act",
+        {"0:Input": [jax.ShapeDtypeStruct((2, 3, 8, 8), np.float32)],
+         "0:Filter": [filt]},
+        {"1:Y": [aval]})
+    # 2*out_elems*cin*kh*kw for the conv + ~10/elem for bn+act
+    out_elems = 2 * 8 * 8 * 8
+    assert flops == 2.0 * out_elems * 3 * 3 * 3 + 10.0 * out_elems
+    assert bytes_ > 0
+
+    p = jax.ShapeDtypeStruct((100,), np.float32)
+    flops, _ = roofline.op_cost(
+        "fused_adam", {"Param": [p, p], "Grad": [p, p]}, {})
+    assert flops == 12.0 * 200
+
+    hlo = """HloModule m
+fused_computation {
+  p0 = f32[8]{0} parameter(0)
+  ROOT add = f32[8]{0} add(p0, p0)
+}
+ENTRY main {
+  x = f32[8]{0} parameter(0)
+  f = f32[8]{0} fusion(x), kind=kLoop, calls=fused_computation
+  ROOT t = (f32[8]{0}, f32[8]{0}) tuple(f, x)
+}
+"""
+    counts = roofline.hlo_counts(hlo)
+    assert counts["fusions"] == 1
+    assert counts["instructions"] >= 5
+
+
+def test_plan_window_kinds():
+    """The planner finds every expected window in the convnet and the
+    gate turns it off wholesale."""
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                                padding=1, bias_attr=False)
+        b = fluid.layers.batch_norm(input=c, act="relu")
+        s = fluid.layers.abs(fluid.layers.scale(b, scale=1.5))
+        gp = fluid.layers.pool2d(input=s, global_pooling=True,
+                                 pool_type="avg")
+        logits = fluid.layers.fc(input=gp, size=5, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9).minimize(
+            loss, startup_program=startup)
+
+    old = fusion_mod.FUSION_OPT
+    try:
+        fusion_mod.FUSION_OPT = True
+        groups = fusion_mod.plan(main)
+        kinds = {g.kind for g in groups.values()}
+        assert {"conv_bn_act", "chain", "fc_act", "opt_bucket"} <= kinds
+        # anchor map is non-overlapping and in block order
+        spans = sorted((g.start, g.end) for g in groups.values())
+        for (s0, e0), (s1, _) in zip(spans, spans[1:]):
+            assert e0 <= s1
+        fusion_mod.FUSION_OPT = False
+        assert fusion_mod.plan(main) is None
+    finally:
+        fusion_mod.FUSION_OPT = old

@@ -185,9 +185,10 @@ def test_conv3d_parity():
 
 
 def _prelu_sum():
-    """prelu's alpha reshape assumes NCHW, so it must NOT ride the NHWC
-    convention (r3 review finding): C != H here so a layout bug breaks
-    broadcasting or silently mis-applies alpha."""
+    """prelu is layout-aware (ISSUE 7): under the NHWC tag its channel
+    alpha broadcasts on the minor axis instead of forcing a barrier.
+    C != H here so a layout bug breaks broadcasting or silently
+    mis-applies alpha."""
     unique_name.switch()
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = 9
@@ -207,6 +208,32 @@ def _prelu_sum():
 
 def test_prelu_after_conv_parity():
     ref, got = _run_modes(_prelu_sum)
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def _prelu_element_sum():
+    """element-mode alpha is stored canonical [1, C, H, W]; under the
+    NHWC tag the lowering must transpose it to minor-channel order, not
+    reshape blindly (H != W != C here so a mix-up changes the sum)."""
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4, 6, 7], dtype="float32")
+        c = fluid.layers.conv2d(input=x, num_filters=5, filter_size=3,
+                                padding=1)
+        p = fluid.layers.prelu(c, mode="element")
+        out = fluid.layers.reduce_sum(p)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with em.scope_guard(em.Scope()):
+        exe.run(startup)
+        v, = exe.run(main, feed={"x": np.ones((2, 4, 6, 7), np.float32)},
+                     fetch_list=[out])
+    return float(np.ravel(v)[0])
+
+
+def test_prelu_element_after_conv_parity():
+    ref, got = _run_modes(_prelu_element_sum)
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
 

@@ -1,0 +1,59 @@
+"""Registry-consistency lint as a tier-1 gate (ISSUE 7 satellite): a
+typo in layout.AGNOSTIC_OPS/AWARE_OPS or the fusion pattern tables
+doesn't raise — the pattern just never matches and the optimization
+silently turns off. tools/check_registry.py pins every table entry
+against ops/registry.py; this test runs it both in-process (precise
+assertion message) and as the CLI (the CI entry point)."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_registry", os.path.join(REPO, "tools", "check_registry.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tables_registered():
+    problems = _load_checker().check_tables()
+    assert not problems, (
+        "optimization tables name unregistered ops: "
+        + ", ".join(f"{t}:{n}" for t, n in problems))
+
+
+def test_tables_nonempty():
+    """The lint is vacuous if an import regression empties a table."""
+    from paddle_tpu.ops import fusion, layout
+
+    assert layout.AWARE_OPS and layout.AGNOSTIC_OPS
+    assert fusion.CONV_OPS and fusion.ACT_OPS and fusion.CHAIN_OPS
+    assert fusion.OPTIMIZER_BUCKET_OPS and fusion.FUSED_OP_TYPES
+
+
+def test_cli_passes():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_registry.py")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr[-1500:]
+    assert "registry lint ok" in r.stdout
+
+
+def test_cli_catches_typo():
+    """Sanity: the checker actually reports a bogus table entry."""
+    from paddle_tpu.ops import layout
+
+    checker = _load_checker()
+    layout.AGNOSTIC_OPS.add("definitely_not_an_op")
+    try:
+        problems = checker.check_tables()
+    finally:
+        layout.AGNOSTIC_OPS.discard("definitely_not_an_op")
+    assert ("layout.AGNOSTIC_OPS", "definitely_not_an_op") in problems

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Registry-consistency lint (ISSUE 7 satellite): every op named in the
+layout pass's AGNOSTIC_OPS/AWARE_OPS sets and in the fusion pass's
+pattern tables must actually be registered in ops/registry.py. A typo in
+one of those tables doesn't raise at runtime — the pattern just never
+matches and the optimization silently turns off — so CI pins the sets
+against the registry instead.
+
+    python tools/check_registry.py        # exits 1 and lists offenders
+
+Names ending in `_grad` are checked against their base op: grad kernels
+are materialized lazily by registry.try_get, so only the forward
+registration proves the name is real.
+"""
+
+import sys
+
+
+def check_tables():
+    """[(table, name), ...] for every table entry with no registration."""
+    from paddle_tpu.ops import fusion, layout, registry
+
+    registered = set(registry.registered_ops())
+    tables = {
+        "layout.AWARE_OPS": layout.AWARE_OPS,
+        "layout.AGNOSTIC_OPS": layout.AGNOSTIC_OPS,
+        "fusion.CONV_OPS": fusion.CONV_OPS,
+        "fusion.ACT_OPS": fusion.ACT_OPS,
+        "fusion.CHAIN_OPS": fusion.CHAIN_OPS,
+        "fusion.OPTIMIZER_BUCKET_OPS": fusion.OPTIMIZER_BUCKET_OPS,
+        "fusion.FUSED_OP_TYPES": fusion.FUSED_OP_TYPES,
+    }
+    problems = []
+    for tname in sorted(tables):
+        for name in sorted(tables[tname]):
+            base = name[:-5] if name.endswith("_grad") else name
+            if base not in registered:
+                problems.append((tname, name))
+    return problems
+
+
+def main():
+    problems = check_tables()
+    for tname, name in problems:
+        print(f"{tname}: '{name}' is not registered in ops/registry.py")
+    if problems:
+        print(f"{len(problems)} unregistered table entr"
+              f"{'y' if len(problems) == 1 else 'ies'}")
+        return 1
+    print("registry lint ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

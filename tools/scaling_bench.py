@@ -164,6 +164,10 @@ def _perf_fields(run_one):
             return {}
         out = {"top_ops": roofline.top_ops(report),
                "device_duty_cycle": report.get("device_duty_cycle")}
+        hc = report.get("hlo_counts")
+        if hc:
+            out["hlo_instructions"] = hc["instructions"]
+            out["hlo_fusions"] = hc["fusions"]
         attributed = [r for r in report["rows"]
                       if r["bound"] != "unattributed"]
         out["bound"] = (attributed[0]["bound"] if attributed
