@@ -411,6 +411,20 @@ def _perf_fields(probe=None):
                       if r["bound"] != "unattributed"]
         out["bound"] = (attributed[0]["bound"] if attributed
                         else "unattributed")
+        try:
+            # fleet fields (ISSUE 8): per-kind bus bandwidth, cross-host
+            # step skew (1.0 single-host) and the goodput fraction
+            from paddle_tpu import fleet
+            bus = fleet.busbw_by_kind(report.get("collectives"))
+            if bus:
+                out["busbw"] = bus
+            snap = fleet.fleet_snapshot()
+            out["fleet_skew"] = round(snap["step_skew"], 4)
+            gp = fleet.goodput_report()
+            if gp:
+                out["goodput"] = round(gp["goodput_fraction"], 4)
+        except Exception:  # noqa: BLE001 - fleet fields are best-effort
+            pass
         return out
     except Exception as e:  # noqa: BLE001 - attribution is best-effort
         sys.stderr.write(f"perf attribution failed: {e}\n")

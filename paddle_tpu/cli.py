@@ -497,6 +497,96 @@ def cmd_perf(args):
     return 0
 
 
+def cmd_fleet(args):
+    """Fleet observability report (fleet.py): per-collective bandwidth
+    attribution (kind, call site, bytes, busbw, % of link roofline,
+    exposed ms), the goodput ledger, and the cross-host skew line. With
+    --smoke the smoke program runs on a dp mesh over every local device
+    (forcing 4 host devices on CPU) so the trace actually contains
+    collectives; with --trace-dir an existing trace is attributed."""
+    import json
+    import os
+
+    probe = not args.no_probe
+    if args.trace_dir:
+        from paddle_tpu import fleet
+        result = {
+            "collectives": fleet.collective_table(args.trace_dir, (),
+                                                  probe=probe),
+            "goodput": fleet.goodput_report(),
+            "snapshot": None,
+        }
+    else:
+        # more than one device makes the smoke's dp mesh real — must be
+        # set before first backend touch, harmless when already decided
+        if "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=4").strip()
+
+        import numpy as np
+        import jax
+
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod, fleet, memory
+
+        with executor_mod.scope_guard(executor_mod.Scope()):
+            spec = memory.build_smoke(args.smoke or "fit_a_line")
+            ndev = max(jax.local_device_count(), 1)
+            spec["main"]._mesh = jax.sharding.Mesh(
+                np.array(jax.local_devices()), ("dp",))
+            batch = max(args.batch, ndev)
+            batch -= batch % ndev     # dp-shardable batch
+            exe = fluid.Executor(fluid.TPUPlace(0))
+            exe.run(spec["startup"])
+            feed = spec["data_fn"](batch)
+
+            def run():
+                return exe.run(spec["main"], feed=feed,
+                               fetch_list=[spec["loss"]])
+
+            run()   # warm compile OUTSIDE the trace
+            result = fleet.capture(run, steps=args.steps, probe=probe)
+
+    if result is None:
+        print("fleet: no report (trace empty or capture failed)")
+        return 1
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True, default=str)
+    if args.json:
+        print(json.dumps(result, indent=2, sort_keys=True, default=str))
+        return 0
+
+    from paddle_tpu import fleet
+    colls = result.get("collectives")
+    if colls and colls.get("rows"):
+        print(f"{'Collective':20s} {'Call site':22s} {'MB':>9s} "
+              f"{'busbw GB/s':>11s} {'% link':>7s} {'Exposed(ms)':>12s}")
+        for r in colls["rows"]:
+            bus = ("{:11.2f}".format(r["busbw_gbps"])
+                   if r.get("busbw_gbps") is not None else
+                   "          -")
+            pct = ("{:6.1%}".format(r["pct_link"])
+                   if r.get("pct_link") is not None else "     -")
+            print("[coll] {:13s} {:22s} {:9.2f} {} {} {:12.3f}".format(
+                r["kind"], r["site"], r["bytes"] / 1e6, bus, pct,
+                r["exposed_ms"]))
+        if colls.get("ici_gbps"):
+            print("[coll] link roofline {:.1f} GB/s ({} participants)"
+                  .format(colls["ici_gbps"],
+                          colls.get("participants") or "?"))
+    else:
+        print("[coll] no collective events in the trace")
+    for line in fleet.format_goodput(result.get("goodput")):
+        print(line)
+    snap = result.get("snapshot")
+    if snap:
+        print(fleet.format_fleet(snap))
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="paddle_tpu",
@@ -606,6 +696,30 @@ def main(argv=None):
     p_perf.add_argument("--no-probe", action="store_true",
                         help="skip the matmul/HBM roofline probes")
     p_perf.set_defaults(fn=cmd_perf)
+
+    p_fleet = sub.add_parser(
+        "fleet", help="fleet observability: per-collective busbw "
+                      "attribution, goodput ledger, cross-host skew")
+    p_fleet.add_argument("--smoke", nargs="?", const="fit_a_line",
+                         default=None,
+                         help="run a built-in smoke program on a dp mesh "
+                              "under a traced session (fit_a_line or "
+                              "resnet; default fit_a_line)")
+    p_fleet.add_argument("--trace-dir",
+                         help="attribute an existing jax.profiler trace "
+                              "dir instead of running anything")
+    p_fleet.add_argument("--steps", type=int, default=3,
+                         help="traced steps for --smoke (default 3)")
+    p_fleet.add_argument("--batch", type=int, default=16,
+                         help="smoke-program batch size, rounded to a "
+                              "multiple of the device count (default 16)")
+    p_fleet.add_argument("--json", action="store_true",
+                         help="print the full report as JSON")
+    p_fleet.add_argument("--report", metavar="PATH",
+                         help="also write the JSON report to PATH")
+    p_fleet.add_argument("--no-probe", action="store_true",
+                         help="skip the ICI/matmul/HBM probes")
+    p_fleet.set_defaults(fn=cmd_fleet)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

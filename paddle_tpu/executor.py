@@ -852,6 +852,11 @@ class Executor:
             "Executor.run wall seconds (dispatch-only unless profiling "
             "forces device sync)", labels=("program", "mode")).labels(
                 program=prog_label, mode="window").observe(run_dt)
+        telemetry.gauge(
+            "executor_last_step_seconds",
+            "wall seconds of the most recent executor step (per-step "
+            "average for run_steps windows) — fleet skew input").set(
+                max(run_dt - compile_s, 0.0) / steps)
         if self._analysis(program)[3]:
             telemetry.counter(
                 "optimizer_steps_total",
@@ -1235,6 +1240,11 @@ class Executor:
             "Executor.run wall seconds (dispatch-only unless profiling "
             "forces device sync)", labels=("program", "mode")).labels(
                 program=prog_label, mode=mode).observe(run_dt)
+        telemetry.gauge(
+            "executor_last_step_seconds",
+            "wall seconds of the most recent executor step (per-step "
+            "average for run_steps windows) — fleet skew input").set(
+                max(run_dt - compile_s, 0.0))
         if self._analysis(program)[3]:
             telemetry.counter(
                 "optimizer_steps_total",
@@ -1657,13 +1667,24 @@ class Executor:
                 # sharded layout for an output and the donated round-trip
                 # mismatches on the following step
                 from jax.sharding import NamedSharding, PartitionSpec
+                from .parallel._collectives import coll_scope
                 pinned = {}
                 for n, v in new_state.items():
                     spec = param_specs.get(n)
                     sh = NamedSharding(mesh, PartitionSpec(*spec)) if spec \
                         else NamedSharding(mesh, PartitionSpec())
                     try:
-                        pinned[n] = jax.lax.with_sharding_constraint(v, sh)
+                        if spec:
+                            # annotated (tensor/ZeRO-sharded) params: the
+                            # resharding collectives GSPMD inserts here get
+                            # a pd.coll site so fleet.py attributes them;
+                            # replicated pins stay untagged (usually no-ops)
+                            with coll_scope("tp_state_pin"):
+                                pinned[n] = \
+                                    jax.lax.with_sharding_constraint(v, sh)
+                        else:
+                            pinned[n] = \
+                                jax.lax.with_sharding_constraint(v, sh)
                     except (TypeError, ValueError):
                         pinned[n] = v
                 new_state = pinned

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -41,6 +42,7 @@ def save_vars(executor: Executor, dirname: str, main_program: Optional[Program]
     The executor argument is kept for API parity; values come from the
     global scope."""
     main_program = main_program or default_main_program()
+    t0 = time.perf_counter()
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]
@@ -66,22 +68,28 @@ def save_vars(executor: Executor, dirname: str, main_program: Optional[Program]
         with open(os.path.join(dirname, save_file_name), "wb") as f:
             pickle.dump({k: (np.asarray(a), l) for k, (a, l)
                          in combine.items()}, f)
-    _record_checkpoint("save", dirname, total_bytes, n_saved)
+    _record_checkpoint("save", dirname, total_bytes, n_saved,
+                       time.perf_counter() - t0)
 
 
-def _record_checkpoint(op: str, dirname: str, nbytes: int, n_vars: int):
+def _record_checkpoint(op: str, dirname: str, nbytes: int, n_vars: int,
+                       seconds: Optional[float] = None):
     """Checkpoint size telemetry: one gauge series per direction plus a
     step-event record, so bench/telemetry logs show how much state each
     save/load moved (ISSUE: memory observability covers disk-bound state
-    too, not just HBM)."""
+    too, not just HBM). `seconds` is the wall duration of the transfer —
+    the goodput ledger prices checkpoint badput from it when the run
+    checkpoints through io.py directly rather than multihost."""
     try:
         from . import telemetry
         telemetry.gauge(
             "checkpoint_bytes",
             "tensor payload bytes of the last save_vars/load_vars",
             labels=("op",)).labels(op=op).set(nbytes)
-        telemetry.log_event(f"checkpoint_{op}", dirname=dirname,
-                            bytes=nbytes, vars=n_vars)
+        fields = {"dirname": dirname, "bytes": nbytes, "vars": n_vars}
+        if seconds is not None:
+            fields["seconds"] = seconds
+        telemetry.log_event(f"checkpoint_{op}", **fields)
     except Exception:
         pass
 
@@ -110,6 +118,7 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
               load_file_name: Optional[str] = None):
     main_program = main_program or default_main_program()
+    t0 = time.perf_counter()
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]
@@ -124,7 +133,8 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
                 total_bytes += np.asarray(arr).nbytes
                 n_loaded += 1
                 scope.set_var(v.name, LoDTensor(arr, lod) if lod else arr)
-        _record_checkpoint("load", dirname, total_bytes, n_loaded)
+        _record_checkpoint("load", dirname, total_bytes, n_loaded,
+                           time.perf_counter() - t0)
         return
     for v in vars:
         path = os.path.join(dirname, v.name)
@@ -134,7 +144,8 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         total_bytes += np.asarray(arr).nbytes
         n_loaded += 1
         scope.set_var(v.name, LoDTensor(arr, lod) if lod else arr)
-    _record_checkpoint("load", dirname, total_bytes, n_loaded)
+    _record_checkpoint("load", dirname, total_bytes, n_loaded,
+                       time.perf_counter() - t0)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):

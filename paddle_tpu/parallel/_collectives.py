@@ -8,6 +8,15 @@ import jax
 from jax import lax
 
 
+def coll_scope(site: str):
+    """Named scope tagging a framework collective call site. The scope
+    lands in HLO metadata op_name as 'pd.coll.<site>', which
+    xplane.hlo_collectives joins back to device-time events so fleet.py
+    can attribute collective cost to the emitting layer (ring-attention
+    rotate, pipeline send, dp grad psum) instead of a bare HLO name."""
+    return jax.named_scope(f"pd.coll.{site}")
+
+
 def mark_varying(x, axis_name: str):
     """Mark a replicated value as varying over `axis_name` for shard_map's
     varying-manifest-axis typechecker (scan carries initialized from
@@ -84,11 +93,12 @@ def process_allgather_bytes(payload: bytes) -> list:
                 for i in range(jax.process_count())]
     from jax.experimental import multihost_utils
     data = np.frombuffer(payload, dtype=np.uint8)
-    sizes = np.asarray(multihost_utils.process_allgather(
-        np.array([data.size], dtype=np.int64))).reshape(-1)
-    padded = np.zeros(int(sizes.max()), dtype=np.uint8)
-    padded[: data.size] = data
-    rows = np.asarray(multihost_utils.process_allgather(padded))
+    with coll_scope("host_allgather"):
+        sizes = np.asarray(multihost_utils.process_allgather(
+            np.array([data.size], dtype=np.int64))).reshape(-1)
+        padded = np.zeros(int(sizes.max()), dtype=np.uint8)
+        padded[: data.size] = data
+        rows = np.asarray(multihost_utils.process_allgather(padded))
     rows = rows.reshape(jax.process_count(), -1)
     return [rows[i, : int(sizes[i])].tobytes()
             for i in range(jax.process_count())]

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import time
 from typing import Callable, Iterable, Optional
 
 __all__ = ["initialize", "shard_reader", "CheckpointableReader",
@@ -178,6 +179,7 @@ def save_checkpoint(executor, dirname: str, step: int, main_program=None,
     main checkpoint. leader_only=False restores the old
     every-process-writes behavior for process-local dirnames."""
     import jax
+    t0 = time.perf_counter()
     os.makedirs(dirname, exist_ok=True)
     rstate = None
     if reader is not None:
@@ -195,6 +197,7 @@ def save_checkpoint(executor, dirname: str, step: int, main_program=None,
         os.replace(tmp, os.path.join(
             dirname, _reader_state_file(jax.process_index())))
     if leader_only and not is_save_leader():
+        _checkpoint_done("save", step, t0)
         return False
     from .. import io as io_mod
     ckpt_dir = os.path.join(dirname, f"step_{step}")
@@ -212,7 +215,19 @@ def save_checkpoint(executor, dirname: str, step: int, main_program=None,
                       "checkpoints written by this process").inc()
     telemetry.gauge("checkpoint_last_step",
                     "step of the newest checkpoint written").set(step)
+    _checkpoint_done("save", step, t0)
     return True
+
+
+def _checkpoint_done(op: str, step, t0: float):
+    """Duration histogram + 'checkpoint' event marker: the goodput ledger
+    (fleet.goodput_report) prices checkpoint badput from these instead of
+    guessing from the checkpoint_bytes gauge."""
+    from .. import telemetry
+    dt = time.perf_counter() - t0
+    telemetry.histogram(f"checkpoint_{op}_seconds",
+                        f"wall seconds per checkpoint {op}").observe(dt)
+    telemetry.log_event("checkpoint", op=op, step=step, seconds=dt)
 
 
 def _reader_state_file(process_index: int) -> str:
@@ -244,8 +259,10 @@ def load_checkpoint(executor, dirname: str, main_program=None,
     meta = latest_checkpoint(dirname)
     if meta is None:
         return None
+    t0 = time.perf_counter()
     ckpt_dir = os.path.join(dirname, f"step_{meta['step']}")
     io_mod.load_persistables(executor, ckpt_dir, main_program=main_program)
+    _checkpoint_done("load", meta["step"], t0)
     if reader is not None:
         rpath = os.path.join(dirname,
                              _reader_state_file(jax.process_index()))

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ._collectives import tree_mark_varying as _pvary
+from ._collectives import coll_scope, tree_mark_varying as _pvary
 
 __all__ = ["gpipe", "gpipe_reference"]
 
@@ -87,7 +87,8 @@ def gpipe(stage_fn, stacked_params, x_microbatches, mesh, axis: str = "pp"):
                 lambda o: o, outs)
             # ship h one hop right (device i -> i+1)
             perm = [(i, i + 1) for i in range(p_size - 1)]
-            nxt = lax.ppermute(h, axis, perm)
+            with coll_scope("pipe_send"):
+                nxt = lax.ppermute(h, axis, perm)
             return (nxt, outs), None
 
         outs0 = _pvary(jnp.zeros((m,) + xs.shape[1:], xs.dtype), axis)
@@ -95,7 +96,9 @@ def gpipe(stage_fn, stacked_params, x_microbatches, mesh, axis: str = "pp"):
         (_, outs), _ = lax.scan(tick, (recv0, outs0),
                                 jnp.arange(ticks))
         # only the last device holds real outputs; replicate via psum
-        return lax.psum(
-            jnp.where(idx == p_size - 1, outs, jnp.zeros_like(outs)), axis)
+        with coll_scope("pipe_replicate"):
+            return lax.psum(
+                jnp.where(idx == p_size - 1, outs, jnp.zeros_like(outs)),
+                axis)
 
     return run(stacked_params, x_microbatches)

@@ -148,9 +148,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         any_valid = any_valid | valid_b
 
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        off_nxt = lax.ppermute(k_off, axis_name, perm)
+        from ._collectives import coll_scope
+        with coll_scope("ring_kv_rotate"):
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
+            off_nxt = lax.ppermute(k_off, axis_name, perm)
         return (k_nxt, v_nxt, off_nxt, acc, l_acc, m_acc, any_valid), None
 
     from ._collectives import mark_varying
@@ -205,11 +207,13 @@ def _ring_bwd_local(q, k, v, do, o, lse, axis_name, causal, scale):
         dk_cur = dk_cur + dk_b.astype(jnp.float32)
         dv_cur = dv_cur + dv_b.astype(jnp.float32)
         perm = [(i, (i + 1) % p_size) for i in range(p_size)]
-        return (lax.ppermute(k_cur, axis_name, perm),
-                lax.ppermute(v_cur, axis_name, perm),
-                lax.ppermute(dk_cur, axis_name, perm),
-                lax.ppermute(dv_cur, axis_name, perm),
-                lax.ppermute(k_off, axis_name, perm), dq), None
+        from ._collectives import coll_scope
+        with coll_scope("ring_bwd_rotate"):
+            return (lax.ppermute(k_cur, axis_name, perm),
+                    lax.ppermute(v_cur, axis_name, perm),
+                    lax.ppermute(dk_cur, axis_name, perm),
+                    lax.ppermute(dv_cur, axis_name, perm),
+                    lax.ppermute(k_off, axis_name, perm), dq), None
 
     def zeros():
         return _vary(jnp.zeros((b, t_local, h, d), jnp.float32))

@@ -23,7 +23,7 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence, Tuple
 
 __all__ = ["shard_parameter", "param_shardings", "shard_fc_params",
-           "shard_all_params_zero"]
+           "shard_all_params_zero", "expected_collectives"]
 
 
 def _specs(program) -> Dict[str, Tuple]:
@@ -61,6 +61,35 @@ def shard_fc_params(program, axis: str = "mp", min_dim: int = 2):
         if shape is not None and len(shape) == 1 and shape[0] in sharded_cols:
             shard_parameter(program, p.name, (axis,))
     return program
+
+
+def expected_collectives(program) -> Dict[str, str]:
+    """{param_name: predicted GSPMD collective pattern} for every annotated
+    parameter — the Megatron algebra in words. Tensor-parallel collectives
+    are partitioner-inserted, so no framework line carries a pd.coll
+    scope for them; the fleet CLI prints these predictions next to the
+    trace's "(gspmd)" rows so an unattributed all-gather still names its
+    probable source parameter."""
+    out: Dict[str, str] = {}
+    for name, spec in param_shardings(program).items():
+        spec = tuple(spec)
+        ndim = len(spec)
+        axes = [a for a in spec if a]
+        if not axes:
+            continue
+        if ndim >= 2 and spec[-1]:
+            out[name] = ("column-parallel ({0}): activation all-gather on "
+                         "use, grad reduce-scatter".format(spec[-1]))
+        elif ndim >= 2 and spec[0]:
+            out[name] = ("row-parallel ({0}): output all-reduce"
+                         .format(spec[0]))
+        elif ndim == 1:
+            out[name] = ("sharded bias ({0}): gathers with its layer"
+                         .format(axes[0]))
+        else:
+            out[name] = ("zero-sharded ({0}): param all-gather on use, "
+                         "grad reduce-scatter".format(axes[0]))
+    return out
 
 
 def shard_all_params_zero(program, axis: str = "dp", min_size: int = 1024):

@@ -182,6 +182,19 @@ def stop_profiler(sorted_key=None, profile_path=None):
     _print_table(sorted_key)
     if trace_dir:
         _print_device_table(trace_dir, sorted_key)
+    try:
+        if jax.process_count() > 1:
+            # multi-process runs get the fleet line: step skew, slowest
+            # host and goodput — the cross-host view no single-host table
+            # above can show
+            from . import fleet
+            print(fleet.format_fleet(fleet.fleet_snapshot()))
+            gp = fleet.goodput_report()
+            if gp:
+                print("[fleet] goodput {:.1%} over {:.2f}s wall".format(
+                    gp["goodput_fraction"], gp["span_s"]))
+    except Exception:  # noqa: BLE001 - summary line is best-effort
+        pass
 
 
 def finish_trace_report(steps: Optional[int] = None, probe: bool = True):

@@ -45,9 +45,9 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 __all__ = ["program_cost", "op_cost", "hlo_counts", "matmul_probe",
-           "hbm_probe", "ensure_probes", "nominal_tflops",
-           "collect_report", "format_report", "capture", "waterfall",
-           "top_ops", "UNATTRIBUTED"]
+           "hbm_probe", "ici_probe", "ensure_probes", "ensure_ici",
+           "nominal_tflops", "collect_report", "format_report", "capture",
+           "waterfall", "top_ops", "UNATTRIBUTED"]
 
 UNATTRIBUTED = "(unattributed)"
 
@@ -384,6 +384,64 @@ def hbm_probe(mbytes: Optional[int] = None, iters: Optional[int] = None,
     return (3.0 * elems * 4 * iters) / best / 1e9
 
 
+def ici_probe(mbytes: Optional[int] = None, repeats: int = 3) \
+        -> Optional[float]:
+    """Sustained interconnect bus bandwidth in GB/s: a jitted all-reduce
+    (psum) of a large array over every local device, timed end to end and
+    converted with the nccl-tests 2(n-1)/n bus-bandwidth factor. On a TPU
+    slice this measures ICI; on the CPU backend with forced host devices
+    it measures the memcpy fabric — either way it is the link roofline
+    per-collective busbw is judged against. None with < 2 devices."""
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.device_count()
+    if n < 2:
+        return None
+    tpu = _platform() == "tpu"
+    mb = mbytes or (64 if tpu else 8)
+    elems = mb * (1 << 20) // 4
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("probe",))
+    spec = jax.sharding.PartitionSpec("probe")
+
+    @jax.jit
+    def ar(x):
+        y = jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(mesh, spec))
+        # reduce ONLY the sharded axis: the [elems] result is replicated,
+        # forcing an all-reduce of the full payload (a scalar-producing
+        # y.sum() would let XLA all-reduce just partial scalars)
+        return y.sum(0)
+
+    x = jnp.ones((n, elems), jnp.float32)
+    ar(x).block_until_ready()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        ar(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    nbytes = elems * 4    # the all-reduced buffer
+    return nbytes * 2.0 * (n - 1) / n / best / 1e9
+
+
+def ensure_ici(probe: bool = True) -> Optional[float]:
+    """Cached ICI/DCN bus bandwidth in GB/s, PADDLE_TPU_ICI_GBPS override
+    first (mirrors ensure_probes). Separate from ensure_probes so the
+    existing matmul/HBM callers don't pay an all-reduce probe."""
+    if "ici_gbps" not in _PROBES:
+        env = os.environ.get("PADDLE_TPU_ICI_GBPS")
+        if env:
+            _PROBES["ici_gbps"] = float(env)
+        elif probe:
+            try:
+                _PROBES["ici_gbps"] = ici_probe()
+            except Exception:  # noqa: BLE001 - probe is advisory
+                _PROBES["ici_gbps"] = None
+        else:
+            return None
+    return _PROBES.get("ici_gbps")
+
+
 def ensure_probes(probe: bool = True) -> Dict[str, Optional[float]]:
     """{"sustained_tflops","hbm_gbps","ridge"} — measured once per process
     and cached; PADDLE_TPU_SUSTAINED_TFLOPS / PADDLE_TPU_HBM_GBPS env
@@ -477,7 +535,8 @@ def waterfall(trace_dir) -> Optional[Dict[str, Any]]:
         if not planes:
             return None
     out = {"compute_ps": 0, "infeed_ps": 0, "collective_ps": 0,
-           "host_gap_ps": 0, "span_ps": 0, "planes": len(planes)}
+           "collective_exposed_ps": 0, "host_gap_ps": 0, "span_ps": 0,
+           "planes": len(planes)}
     for _, lines in planes.items():
         best = None
         best_busy = -1
@@ -492,6 +551,10 @@ def waterfall(trace_dir) -> Optional[Dict[str, Any]]:
         span = max(end - start, best_busy)
         for name, _, dur in best["events"]:
             out[_bucket(name) + "_ps"] += dur
+        # exposed = collective time hidden under NO concurrent compute;
+        # refines the single collectives bucket into hidden vs blocking
+        out["collective_exposed_ps"] += sum(
+            xplane.exposed_in_line(best["events"]).values())
         out["span_ps"] += span
         out["host_gap_ps"] += max(span - best_busy, 0)
     if not out["span_ps"]:
@@ -520,6 +583,7 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
     xla_flops = 0.0
     have_cost = have_xla = False
     hlo = {"modules": 0, "instructions": 0, "fusions": 0}
+    texts: List[str] = []
     notes: List[str] = []
     for pair in suppliers:
         supply, cost_fn = pair if isinstance(pair, tuple) else (pair, None)
@@ -527,6 +591,7 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
             compiled = supply()
             text = compiled if isinstance(compiled, str) \
                 else compiled.as_text()
+            texts.append(text)
             mapping.update(xplane.hlo_op_names(text))
             counts = hlo_counts(text)
             hlo["modules"] += 1
@@ -597,10 +662,20 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
     except Exception as e:  # noqa: BLE001
         notes.append(f"waterfall unavailable: {type(e).__name__}: {e}")
 
+    colls = None
+    try:
+        from . import fleet
+        colls = fleet.collective_table(trace_dir, texts, steps=steps,
+                                       probe=probe)
+    except Exception as e:  # noqa: BLE001
+        notes.append(
+            f"collective attribution unavailable: {type(e).__name__}: {e}")
+
     report: Dict[str, Any] = {
         "trace_dir": str(trace_dir), "steps": steps,
         "device_total_ps": total_ps, "rows": rows,
         "mapped": bool(mapping), "waterfall": wf,
+        "collectives": colls,
         "device_duty_cycle": (wf or {}).get("device_duty_cycle"),
         "sustained_tflops": sustained, "hbm_gbps": probes["hbm_gbps"],
         "ridge_intensity": ridge, "nominal_tflops": nominal,
@@ -634,6 +709,27 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
             telemetry.gauge(
                 gname, f"{gname} from the latest roofline report").set(
                     report[gname])
+    # per-trace collective wait: fleet.local_snapshot and the goodput
+    # ledger read these instead of re-parsing the trace. The fleet table
+    # is the better source (it finds collectives on CPU traces' thread
+    # lines, which the waterfall's busiest-line pick misses).
+    if colls and colls.get("rows"):
+        total_ms = sum(r["time_ms"] for r in colls["rows"])
+        exposed_ms = sum(r["exposed_ms"] for r in colls["rows"])
+    elif wf:
+        total_ms = wf["collective_ps"] / 1e9
+        exposed_ms = wf["collective_exposed_ps"] / 1e9
+    else:
+        total_ms = exposed_ms = None
+    if total_ms is not None:
+        telemetry.gauge(
+            "collective_time_seconds",
+            "total collective device time in the latest traced session"
+        ).set(total_ms / 1e3)
+        telemetry.gauge(
+            "collective_exposed_seconds",
+            "collective time not hidden under compute in the latest "
+            "traced session").set(exposed_ms / 1e3)
     return report
 
 
@@ -659,12 +755,34 @@ def format_report(report: Dict[str, Any]) -> List[str]:
     wf = report.get("waterfall")
     if wf:
         span = wf["span_ps"]
+        coll_txt = "{:.1%}".format(wf["collective_ps"] / span)
+        if wf.get("collective_exposed_ps") is not None \
+                and wf["collective_ps"]:
+            coll_txt += " ({:.0%} exposed)".format(
+                wf["collective_exposed_ps"] / wf["collective_ps"])
         lines.append(
             "[waterfall] compute {:.1%} | infeed {:.1%} | collectives "
-            "{:.1%} | host gap {:.1%}  (span {:.3f} ms)".format(
+            "{} | host gap {:.1%}  (span {:.3f} ms)".format(
                 wf["compute_ps"] / span, wf["infeed_ps"] / span,
-                wf["collective_ps"] / span, wf["host_gap_ps"] / span,
+                coll_txt, wf["host_gap_ps"] / span,
                 span / 1e9))
+    colls = report.get("collectives")
+    if colls and colls.get("rows"):
+        lines.append(
+            f"{'Collective':20s} {'Call site':22s} {'MB':>9s} "
+            f"{'busbw GB/s':>11s} {'% link':>7s} {'Exposed(ms)':>12s}")
+        for r in colls["rows"]:
+            pct = ("{:6.1%}".format(r["pct_link"])
+                   if r.get("pct_link") is not None else "     -")
+            lines.append(
+                "[coll] {:13s} {:22s} {:9.2f} {:>11s} {} {:12.3f}".format(
+                    r["kind"], r["site"], r["bytes"] / 1e6,
+                    _fmt(r.get("busbw_gbps"), 1.0, 2, 11).strip().rjust(11),
+                    pct, r["exposed_ms"]))
+        if colls.get("ici_gbps"):
+            lines.append(
+                "[coll] link roofline {:.1f} GB/s ({} participants)".format(
+                    colls["ici_gbps"], colls.get("participants") or "?"))
     if report.get("sustained_tflops") or report.get("hbm_gbps"):
         ridge = report.get("ridge_intensity")
         lines.append(

@@ -35,6 +35,7 @@ __all__ = [
     "enable_step_log", "disable_step_log", "step_log_path", "read_step_log",
     "export_chrome_trace", "default_buckets", "reset", "program_label",
     "jax_compile_seconds", "signature_of", "read_gauge", "read_series",
+    "read_histogram",
 ]
 
 
@@ -289,6 +290,26 @@ def read_series(name: str) -> Dict[str, float]:
             ",".join(f"{k}={v}" for k, v in zip(fam.labelnames, key)):
                 child.value
             for key, child in fam._children.items()}
+
+
+def read_histogram(name: str, **labels) -> Optional[Dict[str, float]]:
+    """{'sum', 'count'} of one histogram series, or None when the family or
+    the exact label set does not exist. Same read-only contract as
+    read_gauge — never creates the family or a child. Used by fleet.py to
+    price input stall (input_stall_seconds) and checkpoint badput without
+    registering the histograms from an observer."""
+    with _REG._lock:
+        fam = _REG._families.get(name)
+        if fam is None or fam.kind != "histogram":
+            return None
+        if set(labels) != set(fam.labelnames):
+            return None
+        child = fam._children.get(
+            tuple(str(labels[k]) for k in fam.labelnames))
+        if child is None:
+            return None
+        with _VALUES_LOCK:
+            return {"sum": child.sum, "count": child.count}
 
 
 def _host_index() -> int:

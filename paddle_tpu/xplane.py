@@ -27,7 +27,9 @@ from typing import Dict, Optional
 
 __all__ = ["aggregate", "aggregate_dir", "aggregate_lines", "hlo_op_names",
            "attribute", "category", "fields", "parse_plane",
-           "plane_events", "timeline_dir"]
+           "plane_events", "timeline_dir", "COLLECTIVE_KINDS",
+           "collective_kind", "hlo_collectives", "exposed_in_line",
+           "collective_events_dir"]
 
 
 def _varint(buf, i):
@@ -238,19 +240,29 @@ def aggregate_dir(trace_dir) -> Dict[str, int]:
 _HLO_LINE = re.compile(
     r"%?([\w.\-]+)\s*=\s*\S.*metadata=\{[^}]*op_name=\"([^\"]*)\"")
 _PD_SCOPE = re.compile(r"pd\.([A-Za-z0-9_@]+)")
+# framework collective call sites (jax.named_scope("pd.coll.<site>") in
+# parallel/): the site component may contain dots, which _PD_SCOPE's
+# character class deliberately excludes, so it gets its own regex
+_PD_COLL = re.compile(r"pd\.coll\.([A-Za-z0-9_.\-]+)")
 
 
 def hlo_op_names(hlo_text: str) -> Dict[str, str]:
     """{instruction_name: ir_op_type} from optimized-HLO text, using the
     pd.<type> named-scope component of each op_name (instructions outside
     any pd scope — infeed, copies, jax-internal reductions — map to their
-    trailing op_name component)."""
+    trailing op_name component). Instructions inside a pd.coll.<site>
+    collective scope map to 'coll.<site>' so the roofline table shows the
+    emitting call site, not a bare 'coll'."""
     out: Dict[str, str] = {}
     for line in hlo_text.splitlines():
         m = _HLO_LINE.search(line)
         if not m:
             continue
         instr, op_name = m.group(1), m.group(2)
+        coll = _PD_COLL.search(op_name)
+        if coll:
+            out[instr] = "coll." + coll.group(1)
+            continue
         pd = _PD_SCOPE.search(op_name)
         if pd:
             out[instr] = pd.group(1)
@@ -282,3 +294,227 @@ def category(name: str) -> str:
     'fusion'; falls back to the leading token)."""
     tok = name.lstrip("%").split(" ", 1)[0]
     return tok.split(".")[0]
+
+
+# --- collective classification ----------------------------------------------
+
+# (kind, substring patterns) in match order. Covers the HLO spellings
+# ('all-reduce.3', 'all-gather-start'), the squashed forms some runtimes
+# emit ('AllReduce'), and the framework-level names ('ppermute'). The
+# first matching kind wins, so narrower kinds must precede kinds whose
+# patterns are substrings of theirs (tools/check_registry.py lints this
+# table for self-consistency: every pattern must classify as its own
+# kind, or a new entry silently falls into another bucket).
+COLLECTIVE_KINDS = (
+    ("reduce-scatter", ("reduce-scatter", "reducescatter",
+                        "reduce_scatter")),
+    ("all-reduce", ("all-reduce", "allreduce", "all_reduce",
+                    "cross-replica-sum")),
+    ("all-gather", ("all-gather", "allgather", "all_gather")),
+    ("all-to-all", ("all-to-all", "alltoall", "all_to_all")),
+    ("collective-permute", ("collective-permute", "collectivepermute",
+                            "collective_permute", "ppermute")),
+    ("send/recv", ("send", "recv")),
+)
+
+# busbw factor per kind (nccl-tests convention): the ratio of bytes that
+# actually cross links to bytes in the buffer, as a function of the
+# participant count n. all-reduce moves each byte out and back
+# (2(n-1)/n), gather/scatter/alltoall move the (n-1)/n remote fraction,
+# a permute hop and a send/recv pair move the whole buffer once.
+_BUSBW_FACTOR = {
+    "all-reduce": lambda n: 2.0 * (n - 1) / n if n > 1 else 0.0,
+    "all-gather": lambda n: (n - 1) / n if n > 1 else 0.0,
+    "reduce-scatter": lambda n: (n - 1) / n if n > 1 else 0.0,
+    "all-to-all": lambda n: (n - 1) / n if n > 1 else 0.0,
+    "collective-permute": lambda n: 1.0,
+    "send/recv": lambda n: 1.0,
+}
+
+
+def collective_kind(name: str) -> Optional[str]:
+    """Collective kind for an HLO instruction / xplane event name, or None
+    for non-collective events ('fusion.3', 'dot.1', 'infeed')."""
+    low = name.lower()
+    for kind, pats in COLLECTIVE_KINDS:
+        if any(p in low for p in pats):
+            return kind
+    return None
+
+
+def busbw_factor(kind: str, n: int) -> float:
+    fn = _BUSBW_FACTOR.get(kind)
+    return fn(max(int(n), 1)) if fn else 0.0
+
+
+# dtype token -> bytes per element for HLO shape strings ('f32[4,128]')
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3": 1, "f8e5m2": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1,
+    "f8e5m2fnuz": 1, "f8e4m3fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+_SHAPE_TOK = re.compile(r"([a-z]+[0-9]*[a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Payload bytes of an HLO shape string — 'f32[4,128]{1,0}', 'bf16[]'
+    or a tuple '(f32[8], f32[32])'. Async '-start' ops carry (input,
+    output) tuples aliasing one transfer, so tuples report their largest
+    component, not the sum. Unknown dtypes count 4 bytes/elem."""
+    sizes = []
+    for dtype, dims in _SHAPE_TOK.findall(shape_text):
+        if dtype == "token":
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * _DTYPE_BYTES.get(dtype, 4))
+    if not sizes:
+        return 0
+    if shape_text.lstrip().startswith("("):
+        return max(sizes)
+    return sum(sizes)
+
+
+_HLO_COLL = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"
+    r"(\([^)]*\)|[\w\[\]{},:]+)\s+([\w\-]+)(?:\(|\b)")
+
+
+def hlo_collectives(hlo_text: str) -> Dict[str, dict]:
+    """{instruction_name: {"kind", "site", "bytes"}} for the collective
+    instructions of one optimized-HLO module. kind is classified from the
+    opcode via COLLECTIVE_KINDS; site is the pd.coll.<site> named-scope
+    component of metadata op_name (None for GSPMD-inserted collectives
+    outside any tagged region); bytes is the output-shape payload — the
+    '-done' half of an async start/done pair reports 0 bytes so the pair's
+    payload is not double-counted (its device time still joins the site)."""
+    out: Dict[str, dict] = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_COLL.match(line)
+        if not m:
+            continue
+        instr, shape, opcode = m.group(1), m.group(2), m.group(3)
+        kind = collective_kind(opcode)
+        if kind is None:
+            continue
+        site = near = None
+        mm = _HLO_LINE.search(line)
+        if mm:
+            c = _PD_COLL.search(mm.group(2))
+            if c:
+                site = c.group(1)
+            else:
+                # GSPMD-inserted collective: no framework line emitted it,
+                # but it inherits the op_name of the op it was split from —
+                # the pd.<op_type> scope names the responsible layer
+                s = _PD_SCOPE.search(mm.group(2))
+                if s:
+                    near = s.group(1)
+        nbytes = 0 if opcode.endswith("-done") else _shape_bytes(shape)
+        out[instr] = {"kind": kind, "site": site, "near": near,
+                      "bytes": nbytes}
+    return out
+
+
+def hlo_participants(hlo_text: str) -> Optional[int]:
+    """Participant count of the module's collectives, parsed from
+    replica_groups — either the iota form '<=[4]' or explicit groups
+    '{{0,1,2,3}}'. None when the module has no replica_groups."""
+    m = re.search(r"replica_groups=\[[0-9,]+\]<=\[(\d+)\]", hlo_text)
+    if m:
+        return int(m.group(1))
+    m = re.search(r"replica_groups=\{\{([0-9, ]+)\}", hlo_text)
+    if m:
+        return len([t for t in m.group(1).split(",") if t.strip()])
+    return None
+
+
+def exposed_in_line(events) -> Dict[str, int]:
+    """{collective_event_name: exposed_ps} for one line's (name, offset_ps,
+    duration_ps) events: the part of each collective's duration covered by
+    NO concurrent non-collective event. An async all-reduce whose '-done'
+    wait runs under a fusion kernel is hidden (overlapped); collective
+    time with nothing else on the line is exposed step time."""
+    other = []
+    colls = []
+    for name, off, dur in events:
+        if dur <= 0:
+            continue
+        if collective_kind(name) is None:
+            other.append((off, off + dur))
+        else:
+            colls.append((name, off, off + dur))
+    # merge the non-collective intervals once
+    other.sort()
+    merged = []
+    for s, e in other:
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    out: Dict[str, int] = {}
+    for name, s, e in colls:
+        covered = 0
+        for ms, me in merged:
+            if me <= s:
+                continue
+            if ms >= e:
+                break
+            covered += min(e, me) - max(s, ms)
+        out[name] = out.get(name, 0) + max((e - s) - covered, 0)
+    return out
+
+
+def collective_events_dir(trace_dir) -> Dict[str, dict]:
+    """Merge every .xplane.pb under trace_dir into {event_name: {"kind",
+    "total_ps", "exposed_ps"}} for the collective events. Same dedup
+    discipline as aggregate_dir — per plane take each name's MAX across
+    lines (derived step/module lines repeat the raw XLA-op line; on CPU
+    traces collective work also lands on per-device thread lines, so a
+    busiest-line-only pick would miss it), then sum across planes and
+    files. exposed_ps comes from the line that contributed the max: the
+    part of the collective's duration no concurrent non-collective event
+    on that line covers."""
+    device_planes = []
+    host_planes = []
+    for p in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                       recursive=True):
+        for pname, lines in plane_events(p).items():
+            if pname.startswith("/device:"):
+                device_planes.append(lines)
+            else:
+                filtered = []
+                for line in lines:
+                    evs = [e for e in line["events"] if instr_like(e[0])]
+                    if evs:
+                        filtered.append({**line, "events": evs})
+                if filtered:
+                    host_planes.append(filtered)
+    planes = device_planes or host_planes
+    out: Dict[str, dict] = {}
+    for lines in planes:
+        plane_best: Dict[str, tuple] = {}   # name -> (total_ps, exposed_ps)
+        for line in lines:
+            tot: Dict[str, int] = {}
+            for name, _, dur in line["events"]:
+                if collective_kind(name) is not None:
+                    tot[name] = tot.get(name, 0) + dur
+            if not tot:
+                continue
+            exposed = exposed_in_line(line["events"])
+            for name, ps in tot.items():
+                cur = plane_best.get(name)
+                if cur is None or ps > cur[0]:
+                    plane_best[name] = (ps, exposed.get(name, 0))
+        for name, (ps, exp) in plane_best.items():
+            rec = out.setdefault(name, {"kind": collective_kind(name),
+                                        "total_ps": 0, "exposed_ps": 0})
+            rec["total_ps"] += ps
+            rec["exposed_ps"] += exp
+    return out

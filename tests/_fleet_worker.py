@@ -1,0 +1,58 @@
+"""Child process for test_fleet's cross-host skew test: a real 2-process
+jax.distributed bring-up (same harness as _telemetry_worker.py) where each
+process publishes a distinct last-step time — and the slow one a large
+infeed wait — then asserts fleet.fleet_snapshot() reduces to the same
+skew / straggler verdict on BOTH sides.
+
+Run as:  python _fleet_worker.py <coordinator> <nprocs> <pid>
+
+Prints one line `RESULT <json>` on success."""
+
+import json
+import os
+import sys
+
+
+def main(coordinator, nprocs, pid):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLA_FLAGS", "")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu import fleet, telemetry
+    from paddle_tpu.parallel import multihost
+
+    assert multihost.initialize(coordinator_address=coordinator,
+                                num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs
+    assert telemetry._host_index() == pid
+
+    # distinct per-host profile: the last host is the slowest, and its
+    # excess badput is infeed wait — the reduce must name both
+    telemetry.gauge("executor_last_step_seconds",
+                    "wall seconds of the last executed step").set(
+                        0.1 * (pid + 1))
+    if pid == nprocs - 1:
+        telemetry.histogram("input_stall_seconds",
+                            "reader wait per step").observe(0.5)
+
+    snap = fleet.fleet_snapshot()
+    assert snap["n_hosts"] == nprocs, snap
+    assert abs(snap["max_step_s"] - 0.1 * nprocs) < 1e-9, snap
+    want_skew = (0.1 * nprocs) / snap["median_step_s"]
+    assert abs(snap["step_skew"] - want_skew) < 1e-9, snap
+    assert snap["straggler"]["host"] == nprocs - 1, snap
+    assert snap["straggler"]["cause"] == "infeed", snap
+
+    # the reduce published the fleet gauges locally on every host
+    assert telemetry.read_gauge("fleet_step_skew") == snap["step_skew"]
+    assert telemetry.read_gauge("fleet_straggler_host") == float(nprocs - 1)
+
+    print("RESULT " + json.dumps(
+        {"pid": pid, "skew": snap["step_skew"],
+         "straggler": snap["straggler"]}), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

@@ -11,6 +11,14 @@ against the registry instead.
 Names ending in `_grad` are checked against their base op: grad kernels
 are materialized lazily by registry.try_get, so only the forward
 registration proves the name is real.
+
+The collective-kind lint (ISSUE 8 satellite) pins xplane.COLLECTIVE_KINDS
+the same way: every pattern must classify back to its own kind through
+`collective_kind` (match order matters — a pattern shadowed by an earlier
+kind silently misattributes), each busbw factor table entry must have a
+kind and vice versa, and each kind's canonical HLO spelling must land in
+the roofline waterfall's "collective" bucket — otherwise a new kind falls
+into "(unattributed)" or the wrong waterfall bar without any test failing.
 """
 
 import sys
@@ -39,13 +47,55 @@ def check_tables():
     return problems
 
 
+def check_collective_kinds():
+    """[(where, message), ...] consistency problems in the collective
+    classification tables (xplane.COLLECTIVE_KINDS / _BUSBW_FACTOR) and
+    their agreement with the roofline waterfall's bucket patterns."""
+    from paddle_tpu import roofline, xplane
+
+    problems = []
+    kinds = [k for k, _ in xplane.COLLECTIVE_KINDS]
+    if len(set(kinds)) != len(kinds):
+        problems.append(("xplane.COLLECTIVE_KINDS", "duplicate kind"))
+    for kind, pats in xplane.COLLECTIVE_KINDS:
+        for pat in pats:
+            got = xplane.collective_kind(pat)
+            if got != kind:
+                problems.append((
+                    "xplane.COLLECTIVE_KINDS",
+                    f"pattern '{pat}' of kind '{kind}' classifies as "
+                    f"'{got}' — match order shadows it"))
+        # the canonical (first) pattern must also land in the waterfall's
+        # collective bucket, or fleet and waterfall disagree on the split
+        if roofline._bucket(pats[0] + ".1") != "collective":
+            problems.append((
+                "roofline._COLLECTIVE_PAT",
+                f"kind '{kind}' spelling '{pats[0]}' not bucketed as "
+                f"'collective' by the waterfall"))
+        if xplane.busbw_factor(kind, 4) <= 0:
+            problems.append((
+                "xplane._BUSBW_FACTOR",
+                f"kind '{kind}' has no busbw factor — its busbw column "
+                f"would read as raw algbw"))
+    for kind in xplane._BUSBW_FACTOR:
+        if kind not in kinds:
+            problems.append((
+                "xplane._BUSBW_FACTOR",
+                f"factor for unknown kind '{kind}'"))
+    return problems
+
+
 def main():
     problems = check_tables()
     for tname, name in problems:
         print(f"{tname}: '{name}' is not registered in ops/registry.py")
+    coll = check_collective_kinds()
+    for where, msg in coll:
+        print(f"{where}: {msg}")
+    problems = problems + coll
     if problems:
-        print(f"{len(problems)} unregistered table entr"
-              f"{'y' if len(problems) == 1 else 'ies'}")
+        print(f"{len(problems)} lint problem"
+              f"{'' if len(problems) == 1 else 's'}")
         return 1
     print("registry lint ok")
     return 0

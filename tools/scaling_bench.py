@@ -172,6 +172,21 @@ def _perf_fields(run_one):
                       if r["bound"] != "unattributed"]
         out["bound"] = (attributed[0]["bound"] if attributed
                         else "unattributed")
+        try:
+            # fleet fields (ISSUE 8): per-kind busbw for the mesh size
+            # under test, cross-host skew, goodput — scaling regressions
+            # show up here as busbw flatlining while devices grow
+            from paddle_tpu import fleet
+            bus = fleet.busbw_by_kind(report.get("collectives"))
+            if bus:
+                out["busbw"] = bus
+            snap = fleet.fleet_snapshot()
+            out["fleet_skew"] = round(snap["step_skew"], 4)
+            gp = fleet.goodput_report()
+            if gp:
+                out["goodput"] = round(gp["goodput_fraction"], 4)
+        except Exception:  # noqa: BLE001 - fleet fields are best-effort
+            pass
         return out
     except Exception as e:  # noqa: BLE001 - attribution is best-effort
         print(f"perf attribution skipped: {e}", file=sys.stderr)

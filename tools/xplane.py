@@ -4,10 +4,14 @@ them — TF version skew — so this decodes the wire format directly).
 
 Usage: python tools/xplane.py <trace_dir_or_file> [top_n]
        python tools/xplane.py --timeline <trace_dir_or_file> [max_events]
+       python tools/xplane.py --collectives <trace_dir> [top_n]
 
 The default view aggregates per-op totals; --timeline prints each line's
 events in execution order (XLine.timestamp_ns anchor + XEvent.offset_ps),
-the raw view behind the profiler's step-time waterfall.
+the raw view behind the profiler's step-time waterfall; --collectives
+prints the collective events only — kind, total ms and exposed ms (time
+not hidden under concurrent compute), summed per kind at the end — the
+stdlib view behind `python -m paddle_tpu fleet`.
 """
 
 from __future__ import annotations
@@ -50,13 +54,39 @@ def timeline(target, limit):
                   f"{dur / 1e6:10.3f} us  {name[:90]}")
 
 
+def collectives(target, limit):
+    evs = _xplane.collective_events_dir(target)
+    if not evs:
+        print("(no collective events)")
+        return
+    by_kind = {}
+    rows = sorted(evs.items(), key=lambda kv: -kv[1]["total_ps"])
+    print(f"{'total ms':>10s} {'exposed ms':>11s}  kind / event")
+    for name, rec in rows[:limit]:
+        print(f"{rec['total_ps'] / 1e9:10.3f} "
+              f"{rec['exposed_ps'] / 1e9:11.3f}  "
+              f"{rec['kind']:18s} {name[:80]}")
+        agg = by_kind.setdefault(rec["kind"], [0, 0])
+        agg[0] += rec["total_ps"]
+        agg[1] += rec["exposed_ps"]
+    for kind, (tot, exp) in sorted(by_kind.items(), key=lambda kv: -kv[1][0]):
+        print(f"[kind] {kind:18s} {tot / 1e9:10.3f} ms total, "
+              f"{exp / 1e9:.3f} ms exposed")
+
+
 def main():
     args = sys.argv[1:]
     want_timeline = "--timeline" in args
     if want_timeline:
         args.remove("--timeline")
+    want_collectives = "--collectives" in args
+    if want_collectives:
+        args.remove("--collectives")
     target = args[0] if args else "."
     top = int(args[1]) if len(args) > 1 else 30
+    if want_collectives:
+        collectives(target, top)
+        return
     if want_timeline:
         timeline(target, top)
         return
