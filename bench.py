@@ -63,9 +63,21 @@ WARMUP = int(os.environ.get("BENCH_WARMUP", "25"))
 AMP = os.environ.get("BENCH_AMP", "1") == "1"
 # fused multi-step loop (Executor.run_steps): K device steps per Python
 # dispatch. `--steps-per-call K` on the command line or the env var; 1 =
-# the classic per-step path. Every JSON line reports the value so BENCH_r*
-# capture the dispatch-overhead trend.
-STEPS_PER_CALL = int(os.environ.get("BENCH_STEPS_PER_CALL", "1"))
+# the classic per-step path; `auto` measures dispatch overhead + HBM
+# headroom on the compiled step and lets overlap.choose_steps_per_call
+# pick K (ISSUE 9). Every JSON line reports the resolved value so
+# BENCH_r* capture the dispatch-overhead trend.
+
+
+def _parse_steps_per_call(v):
+    v = str(v).strip().lower()
+    if v == "auto":
+        return "auto"
+    return int(v)
+
+
+STEPS_PER_CALL = _parse_steps_per_call(
+    os.environ.get("BENCH_STEPS_PER_CALL", "1"))
 AMP_LEVEL = os.environ.get("BENCH_AMP_LEVEL", "O2")
 # per-chip bf16 peak for MFU reporting (v5e ~197 TF/s, v4 ~275, v5p ~459);
 # override with BENCH_PEAK_TFLOPS for other chips. The in-session
@@ -205,6 +217,49 @@ def _dispatch_overhead_ms(run_step, k, n=10):
     except Exception as e:  # noqa: BLE001 - metric is best-effort
         sys.stderr.write(f"dispatch-overhead probe failed: {e}\n")
         return None
+
+
+def _auto_steps_per_call(exe, prog, run_step, feed, fetch):
+    """`--steps-per-call auto`: measure the per-dispatch Python overhead
+    and per-step device time on the already-compiled K=1 path, bound the
+    window by the HBM headroom left over the K=1 footprint (HeadroomModel
+    over the stacked feed window's linear growth), and let
+    overlap.choose_steps_per_call pick K. Any probe failure degrades to
+    whatever signals remain — the choice must never kill the bench."""
+    from paddle_tpu.parallel import overlap as overlap_mod
+
+    step_ms = None
+    try:
+        out = run_step()
+        float(np.asarray(out).ravel()[0])        # compile + drain
+        n = 5
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = run_step()
+        float(np.asarray(out).ravel()[0])
+        step_ms = (time.perf_counter() - t0) / n * 1e3
+    except Exception as e:  # noqa: BLE001 - probe is best-effort
+        sys.stderr.write(f"auto steps-per-call timing probe failed: {e}\n")
+    overhead_ms = _dispatch_overhead_ms(run_step, 1)
+    peak = budget = feed_bytes = None
+    try:
+        from paddle_tpu import memory as memory_mod
+        rec = exe.static_memory_analysis(prog, feed=feed,
+                                         fetch_list=[fetch])
+        peak = rec.total_bytes
+        budget = memory_mod.default_budget(exe.device)
+        feed_bytes = int(sum(np.asarray(v).nbytes for v in feed.values()))
+    except Exception as e:  # noqa: BLE001 - probe is best-effort
+        sys.stderr.write(f"auto steps-per-call memory probe failed: {e}\n")
+    k = overlap_mod.choose_steps_per_call(
+        python_overhead_ms=overhead_ms, step_time_ms=step_ms,
+        feed_bytes_per_step=feed_bytes, peak_bytes=peak,
+        budget_bytes=budget)
+    sys.stderr.write(
+        f"steps-per-call auto -> {k} (dispatch {overhead_ms}ms/step, "
+        f"step {None if step_ms is None else round(step_ms, 3)}ms, "
+        f"feed {feed_bytes}B, peak {peak}B, budget {budget}B)\n")
+    return k
 
 
 _TRANSIENT_MARKERS = (
@@ -418,6 +473,11 @@ def _perf_fields(probe=None):
             bus = fleet.busbw_by_kind(report.get("collectives"))
             if bus:
                 out["busbw"] = bus
+            # overlap fields (ISSUE 9): collective time NOT hidden by
+            # compute, and the hidden fraction — the tentpole's own metric
+            es = fleet.exposed_summary(report.get("collectives"))
+            if es:
+                out.update(es)
             snap = fleet.fleet_snapshot()
             out["fleet_skew"] = round(snap["step_skew"], 4)
             gp = fleet.goodput_report()
@@ -435,7 +495,11 @@ def _emit(payload, errors=()):
     """Print the ONE JSON line the driver parses. Attaches the retry error
     log and the session roofline (sustained TF/s + MFU against it) so a
     partial or degraded run is visible but still parseable."""
-    payload.setdefault("steps_per_call", STEPS_PER_CALL)
+    # families that resolved `auto` set the chosen K explicitly; the rest
+    # (LoD families can't window) effectively ran the per-step path
+    payload.setdefault("steps_per_call",
+                       STEPS_PER_CALL if isinstance(STEPS_PER_CALL, int)
+                       else 1)
     allerr = _CARRIED_ERRORS + list(errors)
     if allerr:
         payload["errors"] = allerr
@@ -507,6 +571,16 @@ def main_cnn(family, train=True):
     if train:
         shapes.append(("label", (1,), classes))  # infer programs take no label
     k = STEPS_PER_CALL
+    if k == "auto":
+        probe_feeds = _feeds(exe, batch, shapes, rng)
+
+        def step1():
+            out, = exe.run(main_prog, feed=next(probe_feeds),
+                           fetch_list=[fetch], return_numpy=False)
+            return out
+
+        k = _auto_steps_per_call(exe, main_prog, step1, next(probe_feeds),
+                                 fetch)
     if k > 1:
         windows = _windows(exe, batch, shapes, rng, k)
 
@@ -548,6 +622,9 @@ def main_cnn(family, train=True):
         "amp": AMP if train else False,
         "amp_level": (AMP_LEVEL if AMP else None) if train else None,
         "steps_timed": done,
+        "steps_per_call": k,
+        "steps_per_call_mode": ("auto" if STEPS_PER_CALL == "auto"
+                                else "fixed"),
         "python_overhead_per_step_ms": overhead_ms,
         "mfu": round(mfu, 4),
     }, errors)
@@ -585,6 +662,16 @@ def main_fc():
     rng = np.random.default_rng(0)
     shapes = [("x", (784,), "img"), ("label", (1,), classes)]
     k = STEPS_PER_CALL
+    if k == "auto":
+        probe_feeds = _feeds(exe, bsz, shapes, rng)
+
+        def step1():
+            out, = exe.run(main_prog, feed=next(probe_feeds),
+                           fetch_list=[avg_cost], return_numpy=False)
+            return out
+
+        k = _auto_steps_per_call(exe, main_prog, step1, next(probe_feeds),
+                                 avg_cost)
     if k > 1:
         windows = _windows(exe, bsz, shapes, rng, k)
 
@@ -619,6 +706,9 @@ def main_fc():
         "vs_baseline": None,   # no reference-published MLP anchor
         "batch": bsz, "hidden": hid, "amp": AMP,
         "steps_timed": done,
+        "steps_per_call": k,
+        "steps_per_call_mode": ("auto" if STEPS_PER_CALL == "auto"
+                                else "fixed"),
         "python_overhead_per_step_ms": _dispatch_overhead_ms(step, k),
         "mfu": round(mfu, 4),
     }, errors)
@@ -970,7 +1060,8 @@ def main():
 if __name__ == "__main__":
     args = sys.argv[1:]
     if "--steps-per-call" in args:
-        STEPS_PER_CALL = int(args[args.index("--steps-per-call") + 1])
+        STEPS_PER_CALL = _parse_steps_per_call(
+            args[args.index("--steps-per-call") + 1])
     if "--families" in args:
         # run several families back-to-back, one JSON line each
         # (e.g. `bench.py --families fc,resnet,lstm`); exit code is the

@@ -221,6 +221,17 @@ def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
         gname = grad_var_name(p.name)
         if produced_count.get(gname):
             result.append((p, block.var(gname)))
+    # record the (param, grad) pairing for the overlap pass
+    # (parallel/overlap.py): grad names follow the grad_var_name
+    # convention, but only append_backward knows which params actually
+    # received a gradient in THIS program
+    pairs = getattr(program, "_grad_param_pairs", None)
+    if pairs is None:
+        pairs = program._grad_param_pairs = []
+    for p, g in result:
+        ent = (p.name, g.name)
+        if ent not in pairs:
+            pairs.append(ent)
     return result
 
 

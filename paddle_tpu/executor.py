@@ -1586,22 +1586,34 @@ class Executor:
         # agnostic, so fold-mode elision re-checks against the names this
         # trace must materialize.
         from .ops import fusion as fusion_mod
+        from .parallel import overlap as overlap_mod
         groups = fusion_mod.plan(program)
-        if not groups:
+        # communication/compute overlap pass (parallel/overlap.py,
+        # PADDLE_TPU_OVERLAP=1): dp gradient buckets flush — pin to the
+        # replicated sharding under their pd.coll scope — right after
+        # their last producing grad op, instead of resolving lazily at
+        # the optimizer. Bitwise-neutral; only the sync point moves.
+        oplan = overlap_mod.plan(program)
+        if not groups and oplan is None:
             for op in block.ops:
                 self._exec_op(ctx, op, env)
         else:
             protected = set(fetch_names) | set(persist_out)
             ops = block.ops
+            groups = groups or {}
             i = 0
             while i < len(ops):
                 g = groups.get(i)
                 if g is not None:
                     fusion_mod.execute_group(self, ctx, g, env, protected)
-                    i = g.end
+                    nxt = g.end
                 else:
                     self._exec_op(ctx, ops[i], env)
-                    i += 1
+                    nxt = i + 1
+                if oplan is not None:
+                    # anchors inside a fused window flush after the window
+                    oplan.flush_range(ctx, env, i, nxt)
+                i = nxt
         if ctx.layouts:
             # fetches and persistable state leave the trace in canonical
             # NCHW — the internal NHWC convention never escapes a run
@@ -1753,17 +1765,31 @@ class Executor:
         feed_shardings = {n: _feed_sharding(n) for n in feed_names}
         return feed_shardings, state_shardings, repl
 
+    def _jit_compile(self, program, fn, sh):
+        """The ONE jax.jit call site for both compile paths (per-step and
+        the run_steps scan window). Consolidated so compiler options — the
+        overlap pass's async-collective + latency-hiding-scheduler set
+        today, anything else tomorrow — reach EVERY path; before this the
+        four duplicated call sites each had to be patched in step.
+        tools/check_registry.py lints this file down to exactly one
+        direct jit call site, so a new path can't silently skip it.
+        `compiler_options()` returns None (plain compile) off-mesh, off-
+        gate, on non-TPU backends, or when the probe rejects the set."""
+        from .parallel import overlap as overlap_mod
+        kwargs: Dict[str, Any] = {"donate_argnums": (1,)}
+        if sh is not None:
+            feed_shardings, state_shardings, repl = sh
+            kwargs["in_shardings"] = (feed_shardings, state_shardings, repl)
+        opts = overlap_mod.compiler_options(program)
+        if opts:
+            kwargs["compiler_options"] = opts
+        return jax.jit(fn, **kwargs)
+
     def _compile(self, program, state_names, feed_names, fetch_names,
                  persist_out, lod_map) -> _CompiledBlock:
         fn = self._make_step_fn(program, fetch_names, persist_out, lod_map)
         sh = self._shardings(program, state_names, feed_names)
-        if sh is not None:
-            feed_shardings, state_shardings, repl = sh
-            jitted = jax.jit(
-                fn, donate_argnums=(1,),
-                in_shardings=(feed_shardings, state_shardings, repl))
-        else:
-            jitted = jax.jit(fn, donate_argnums=(1,))
+        jitted = self._jit_compile(program, fn, sh)
         return _CompiledBlock(jitted, state_names, feed_names, fetch_names,
                               program)
 
@@ -1813,13 +1839,7 @@ class Executor:
             return fetch, new_state
 
         sh = self._shardings(program, state_names, feed_names, window=True)
-        if sh is not None:
-            feed_shardings, state_shardings, repl = sh
-            jitted = jax.jit(
-                fnK, donate_argnums=(1,),
-                in_shardings=(feed_shardings, state_shardings, repl))
-        else:
-            jitted = jax.jit(fnK, donate_argnums=(1,))
+        jitted = self._jit_compile(program, fnK, sh)
         return _CompiledBlock(jitted, state_names, feed_names, fetch_names,
                               program)
 

@@ -35,20 +35,31 @@ class DoubleBufferedFeeder:
     convention). to_feed: batch -> {name: ndarray/LoDTensor} (e.g.
     DataFeeder.feed, or identity for dict readers). device: target
     jax.Device for the prefetch copies. capacity: queue depth (2 =
-    double buffering, the reference's default)."""
+    double buffering, the reference's default). window_prefetch: how many
+    STACKED next_window windows to build ahead (1 = the classic
+    synchronous stack: only the per-batch producer overlaps; >1 moves
+    the stack + device_put of up to that many windows onto a background
+    thread, so window N+1's host work fully overlaps window N's
+    compute)."""
 
     def __init__(self, reader: Callable[[], Iterable], to_feed=None,
-                 device=None, capacity: int = 2):
+                 device=None, capacity: int = 2, window_prefetch: int = 1):
         self.reader = reader
         self.to_feed = to_feed or (lambda b: b)
         self.device = device
         self.capacity = capacity
+        self.window_prefetch = max(1, int(window_prefetch))
         self._thread: Optional[threading.Thread] = None
         self._queue: Optional[queue.Queue] = None
         self._stop = threading.Event()
         # persistent consumer generator for next_window: windows pull from
         # ONE pass rather than restarting the reader per window
         self._consumer = None
+        # window-builder thread state (window_prefetch > 1)
+        self._wthread: Optional[threading.Thread] = None
+        self._wqueue: Optional[queue.Queue] = None
+        self._wstop = threading.Event()
+        self._wkey = None
 
     def _produce(self):
         try:
@@ -107,8 +118,14 @@ class DoubleBufferedFeeder:
         per-batch device_put in the producer would force the stack back
         through the host. Raises StopIteration at end of pass; a short
         remainder (< k batches, XLA would need a fresh window shape) is
-        dropped and counted in input_window_dropped_batches_total."""
+        dropped and counted in input_window_dropped_batches_total.
+
+        With window_prefetch > 1 the stack + device_put happens on a
+        background window-builder thread holding up to window_prefetch
+        ready windows in a bounded queue — this call just dequeues."""
         from .. import telemetry
+        if self.window_prefetch > 1:
+            return self._next_window_prefetched(k, device)
         if self._consumer is None:
             self._consumer = iter(self)
         feeds: List[Dict[str, Any]] = []
@@ -117,12 +134,16 @@ class DoubleBufferedFeeder:
                 feeds.append(next(self._consumer))
         except StopIteration:
             self._consumer = None
-            if feeds:
-                telemetry.counter(
-                    "input_window_dropped_batches_total",
-                    "end-of-pass remainder batches shorter than the "
-                    "window").inc(len(feeds))
+            self._count_dropped(len(feeds))
             raise StopIteration from None
+        window = self._stack_window(feeds, device)
+        telemetry.counter(
+            "input_windows_total",
+            "stacked k-step windows delivered by prefetch feeders").inc()
+        return window
+
+    @staticmethod
+    def _stack_window(feeds: List[Dict[str, Any]], device):
         names = set(feeds[0])
         if any(set(f) != names for f in feeds[1:]):
             raise ValueError("window batches must share the same feed names")
@@ -131,10 +152,95 @@ class DoubleBufferedFeeder:
         if device is not None:
             window = {n: jax.device_put(v, device)
                       for n, v in window.items()}
+        return window
+
+    @staticmethod
+    def _count_dropped(n: int):
+        if n:
+            from .. import telemetry
+            telemetry.counter(
+                "input_window_dropped_batches_total",
+                "end-of-pass remainder batches shorter than the "
+                "window").inc(n)
+
+    def _produce_windows(self, k: int, device, wq, wstop):
+        """Window-builder thread body: pull k batches at a time from the
+        batch pipeline, stack + device_put, enqueue the ready window.
+        `wq`/`wstop` are locals (not self attributes) so a builder
+        abandoned by a (k, device) change can neither pollute its
+        replacement's queue nor block forever on its own."""
+        def _put(item):
+            while not wstop.is_set():
+                try:
+                    wq.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        try:
+            it = iter(self)
+            while not wstop.is_set():
+                feeds: List[Dict[str, Any]] = []
+                try:
+                    while len(feeds) < k:
+                        feeds.append(next(it))
+                except StopIteration:
+                    self._count_dropped(len(feeds))
+                    _put(_STOP)
+                    return
+                if not _put(self._stack_window(feeds, device)):
+                    return
+        except BaseException as e:        # surface in the consumer
+            _put(e)
+
+    def _next_window_prefetched(self, k: int, device):
+        from .. import telemetry
+        key = (k, device)
+        if self._wthread is None or self._wkey != key:
+            self._stop_windows()
+            self._wkey = key
+            self._wstop = threading.Event()
+            self._wqueue = queue.Queue(maxsize=self.window_prefetch)
+            self._wthread = threading.Thread(
+                target=self._produce_windows,
+                args=(k, device, self._wqueue, self._wstop), daemon=True)
+            self._wthread.start()
+        item = self._wqueue.get()
+        if item is _STOP:
+            self._wthread.join()
+            self._wthread = None
+            self._wkey = None
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._wthread.join()
+            self._wthread = None
+            self._wkey = None
+            raise item
         telemetry.counter(
             "input_windows_total",
             "stacked k-step windows delivered by prefetch feeders").inc()
-        return window
+        return item
+
+    def _stop_windows(self):
+        # the builder itself resets the nested batch pipeline through
+        # iter(self) -> reset() -> stop(); never self-join from there
+        if self._wthread is None or \
+                self._wthread is threading.current_thread():
+            return
+        self._wstop.set()
+        try:                      # unblock a builder stuck on batch get()
+            self._queue.put_nowait(_STOP)
+        except (queue.Full, AttributeError):
+            pass
+        try:                      # unblock a builder stuck on window put()
+            while True:
+                self._wqueue.get_nowait()
+        except queue.Empty:
+            pass
+        self._wthread.join(timeout=5)
+        self._wthread = None
+        self._wkey = None
 
     def reset(self):
         # NOTE: does not touch _consumer — __iter__'s generator body calls
@@ -147,6 +253,7 @@ class DoubleBufferedFeeder:
         self._thread.start()
 
     def stop(self):
+        self._stop_windows()
         if self._thread is not None:
             self._stop.set()
             try:                      # unblock a producer stuck on put()

@@ -78,6 +78,62 @@ class TestNextWindow:
             dbf.next_window(2)
 
 
+class TestWindowPrefetch:
+    """window_prefetch > 1 (ISSUE 9 satellite): the stack + device_put
+    moves to a background window-builder thread; the stream must stay
+    identical to the synchronous path — same windows, same order, same
+    dropped-remainder accounting."""
+
+    def _feeder(self, n, depth):
+        batches = [{"x": np.full((2, 3), i, np.float32)} for i in range(n)]
+        return DoubleBufferedFeeder(lambda: iter(batches),
+                                    window_prefetch=depth)
+
+    def test_same_stream_as_synchronous(self):
+        sync, pre = self._feeder(9, 1), self._feeder(9, 3)
+        for _ in range(3):
+            a, b = sync.next_window(3), pre.next_window(3)
+            np.testing.assert_array_equal(a["x"], b["x"])
+        pre.stop()
+
+    def test_remainder_dropped_and_counted(self):
+        from paddle_tpu import telemetry
+        dbf = self._feeder(7, 2)
+        dbf.next_window(3)
+        dbf.next_window(3)
+        before = sum(telemetry.read_series(
+            "input_window_dropped_batches_total").values())
+        with pytest.raises(StopIteration):
+            dbf.next_window(3)   # only batch 6 left on this pass
+        dropped = sum(telemetry.read_series(
+            "input_window_dropped_batches_total").values()) - before
+        assert dropped == 1
+        # reusable: the next call starts a fresh pass from batch 0
+        w = dbf.next_window(3)
+        np.testing.assert_array_equal(w["x"][:, 0, 0], [0, 1, 2])
+        dbf.stop()
+
+    def test_reader_error_surfaces_in_consumer(self):
+        def bad_reader():
+            yield {"x": np.zeros((1,), np.float32)}
+            raise ValueError("boom")
+
+        dbf = DoubleBufferedFeeder(bad_reader, window_prefetch=2)
+        with pytest.raises(ValueError, match="boom"):
+            dbf.next_window(2)
+        dbf.stop()
+
+    def test_stop_terminates_builder_thread(self):
+        dbf = self._feeder(50, 2)
+        dbf.next_window(2)
+        t = dbf._wthread
+        assert t is not None and t.is_alive()
+        dbf.stop()
+        assert dbf._wthread is None
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+
 class TestFeedWindow:
     def test_data_feeder_feed_window(self):
         prog = fluid.Program()

@@ -85,6 +85,43 @@ def check_collective_kinds():
     return problems
 
 
+def check_jit_sites():
+    """[(where, message), ...] — executor.py must funnel every compile
+    through the single `Executor._jit_compile` jit call site (ISSUE 9):
+    that is where the overlap pass's compiler_options (latency-hiding
+    scheduler, async collectives) are threaded, so a new direct call
+    site would silently compile without them. The module-level `@jax.jit`
+    decorator (no parenthesis) is the one sanctioned exception."""
+    import inspect
+
+    from paddle_tpu import executor
+
+    problems = []
+    src = inspect.getsource(executor)
+    sites = src.count("jax.jit(")
+    if sites != 1:
+        problems.append((
+            "executor.jax.jit",
+            f"{sites} direct jit call sites in executor.py (expected "
+            f"exactly 1, inside _jit_compile) — a new site skips the "
+            f"overlap compiler_options threading"))
+    helper = getattr(executor.Executor, "_jit_compile", None)
+    if helper is None:
+        problems.append(("executor._jit_compile",
+                         "Executor._jit_compile helper is missing"))
+    else:
+        hsrc = inspect.getsource(helper)
+        if "jax.jit(" not in hsrc:
+            problems.append((
+                "executor._jit_compile",
+                "the single jit call site is not inside _jit_compile"))
+        if "compiler_options(" not in hsrc:
+            problems.append((
+                "executor._jit_compile",
+                "_jit_compile does not thread overlap.compiler_options"))
+    return problems
+
+
 def main():
     problems = check_tables()
     for tname, name in problems:
@@ -92,7 +129,10 @@ def main():
     coll = check_collective_kinds()
     for where, msg in coll:
         print(f"{where}: {msg}")
-    problems = problems + coll
+    jit = check_jit_sites()
+    for where, msg in jit:
+        print(f"{where}: {msg}")
+    problems = problems + coll + jit
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")

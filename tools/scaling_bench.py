@@ -49,6 +49,56 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
 
+def _parse_steps_per_call(v):
+    v = str(v).strip().lower()
+    return "auto" if v == "auto" else int(v)
+
+
+def _auto_steps_per_call(exe, prog, run_step, feed, fetch):
+    """`--steps-per-call auto` (ISSUE 9): probe the already-compiled K=1
+    path for per-dispatch Python overhead and per-step device time, bound
+    the window by the HBM headroom over the K=1 footprint, and let
+    overlap.choose_steps_per_call pick K. Probe failures degrade to
+    whatever signals remain — the sweep must never die here."""
+    from paddle_tpu.parallel import overlap as overlap_mod
+
+    step_ms = overhead_ms = None
+    try:
+        out = run_step()
+        float(np.asarray(out).ravel()[0])         # compile + drain
+        n = 5
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = run_step()
+        float(np.asarray(out).ravel()[0])
+        step_ms = (time.perf_counter() - t0) / n * 1e3
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = run_step()              # enqueue-only: host-side cost
+        overhead_ms = (time.perf_counter() - t0) / n * 1e3
+        float(np.asarray(out).ravel()[0])
+    except Exception as e:  # noqa: BLE001 - probe is best-effort
+        print(f"auto steps-per-call timing probe failed: {e}",
+              file=sys.stderr)
+    peak = budget = feed_bytes = None
+    try:
+        from paddle_tpu import memory as memory_mod
+        rec = exe.static_memory_analysis(prog, feed=feed,
+                                         fetch_list=[fetch])
+        peak = rec.total_bytes
+        budget = memory_mod.default_budget(exe.device)
+        feed_bytes = int(sum(np.asarray(v).nbytes for v in feed.values()))
+    except Exception as e:  # noqa: BLE001 - probe is best-effort
+        print(f"auto steps-per-call memory probe failed: {e}",
+              file=sys.stderr)
+    k = overlap_mod.choose_steps_per_call(
+        python_overhead_ms=overhead_ms, step_time_ms=step_ms,
+        feed_bytes_per_step=feed_bytes, peak_bytes=peak,
+        budget_bytes=budget)
+    print(f"steps-per-call auto -> {k}", file=sys.stderr)
+    return k
+
+
 def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
             steps_per_call=None):
     # SCALE_BS/SCALE_STEPS shrink the config for mechanism checks on CPU
@@ -61,8 +111,10 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
     if per_device_batch is None:
         per_device_batch = int(os.environ.get("SCALE_BS", "128"))
     if steps_per_call is None:
-        steps_per_call = int(os.environ.get("SCALE_STEPS_PER_CALL", "1"))
-    if steps < 1 or per_device_batch < 1 or steps_per_call < 1:
+        steps_per_call = _parse_steps_per_call(
+            os.environ.get("SCALE_STEPS_PER_CALL", "1"))
+    if steps < 1 or per_device_batch < 1 or (
+            steps_per_call != "auto" and steps_per_call < 1):
         raise SystemExit(
             "SCALE_STEPS, SCALE_BS and SCALE_STEPS_PER_CALL must be >= 1")
     warmup = max(warmup, 1)   # the sync readback needs at least one run
@@ -96,30 +148,40 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
         x = rng.standard_normal((batch, 3, 32, 32), dtype=np.float32)
         y = rng.integers(0, 10, (batch, 1)).astype(np.int64)
         k = steps_per_call
-        # per-step feed is always built: the k=1 path runs on it, and
-        # static_memory_analysis below reports the per-STEP footprint
+        # per-step feed is always built: the k=1 path runs on it (also the
+        # probe path for `auto`), and static_memory_analysis below reports
+        # the per-STEP footprint
         feed = {"img": jax.device_put(x), "label": jax.device_put(y)}
-        if k > 1:
-            # fused window: one [K, B, ...] feed, K steps per dispatch;
-            # the dp state shardings ride the scan carry
-            window = {"img": jax.device_put(np.stack([x] * k)),
-                      "label": jax.device_put(np.stack([y] * k))}
 
-            def run_one():
-                out, = exe.run_steps(main, feed_window=window, steps=k,
-                                     fetch_list=[avg_cost],
-                                     fetch_mode="last", return_numpy=False)
-                return out
-        else:
-            def run_one():
-                out, = exe.run(main, feed=feed, fetch_list=[avg_cost],
-                               return_numpy=False)
-                return out
+        def run_step():
+            out, = exe.run(main, feed=feed, fetch_list=[avg_cost],
+                           return_numpy=False)
+            return out
 
-        warm_calls = max(1, -(-warmup // k))
-        calls = max(1, steps // k)
         with em.scope_guard(em.Scope()):
             exe.run(startup)
+            if k == "auto":
+                # probe the compiled K=1 path for dispatch overhead, step
+                # time and HBM headroom, then let the overlap pass pick K
+                k = _auto_steps_per_call(exe, main, run_step, feed,
+                                         avg_cost)
+            if k > 1:
+                # fused window: one [K, B, ...] feed, K steps per
+                # dispatch; the dp state shardings ride the scan carry
+                window = {"img": jax.device_put(np.stack([x] * k)),
+                          "label": jax.device_put(np.stack([y] * k))}
+
+                def run_one():
+                    out, = exe.run_steps(main, feed_window=window,
+                                         steps=k, fetch_list=[avg_cost],
+                                         fetch_mode="last",
+                                         return_numpy=False)
+                    return out
+            else:
+                run_one = run_step
+
+            warm_calls = max(1, -(-warmup // k))
+            calls = max(1, steps // k)
             for _ in range(warm_calls):
                 out = run_one()
             float(np.asarray(out).ravel()[0])
@@ -141,7 +203,7 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
                 pass
             perf = _perf_fields(run_one)
     assert np.isfinite(final)
-    return batch * steps / dt, peak_hbm, perf
+    return batch * steps / dt, peak_hbm, perf, k
 
 
 def _perf_fields(run_one):
@@ -180,6 +242,11 @@ def _perf_fields(run_one):
             bus = fleet.busbw_by_kind(report.get("collectives"))
             if bus:
                 out["busbw"] = bus
+            # overlap fields (ISSUE 9): exposed collective seconds and
+            # the hidden fraction, per mesh size
+            es = fleet.exposed_summary(report.get("collectives"))
+            if es:
+                out.update(es)
             snap = fleet.fleet_snapshot()
             out["fleet_skew"] = round(snap["step_skew"], 4)
             gp = fleet.goodput_report()
@@ -206,9 +273,10 @@ def main(argv):
     if "--steps-per-call" in argv:
         i = argv.index("--steps-per-call")
         try:
-            steps_per_call = int(argv[i + 1])
+            steps_per_call = _parse_steps_per_call(argv[i + 1])
         except (IndexError, ValueError):
-            raise SystemExit("--steps-per-call needs an integer argument")
+            raise SystemExit(
+                "--steps-per-call needs an integer argument or 'auto'")
         del argv[i:i + 2]
     if steps_per_call is None:
         steps_per_call = int(os.environ.get("SCALE_STEPS_PER_CALL", "1"))
@@ -221,14 +289,16 @@ def main(argv):
             f"{len(jax.devices())} available devices")
     results = {}
     for n in sizes:
-        sps, peak_hbm, perf = measure(n, steps_per_call=steps_per_call)
+        sps, peak_hbm, perf, k = measure(n, steps_per_call=steps_per_call)
         results[n] = sps
         base = results[min(results)]
         eff = sps / (base / min(results) * n)
+        # `steps_per_call` is the K that actually ran (auto resolves
+        # per mesh size); the summary line keeps the requested value
         line = {"devices": n,
                 "samples_per_sec": round(sps, 2),
                 "scaling_efficiency": round(eff, 4),
-                "steps_per_call": steps_per_call,
+                "steps_per_call": k,
                 "peak_hbm_bytes": peak_hbm}
         line.update(perf)
         print(json.dumps(line), flush=True)
