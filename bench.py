@@ -26,6 +26,11 @@ BENCH_MODE selects the config family:
   ring_attention     transformer-LM T=32k train step, flash ring over an
                      'sp' mesh of all visible devices; vs the r4 1.58 s/step
                      regression anchor
+  embedding          criteo-DLRM-style sparse embedding train step: a
+                     [BENCH_EMB_ROWS x BENCH_EMB_DIM] table fsdp-sharded
+                     over all visible devices, SelectedRows gradients and
+                     Adam scatter-apply end-to-end; rows_touched_per_sec
+                     plus per-shard HBM table bytes (ISSUE 10)
 
 `--steps-per-call K` (or BENCH_STEPS_PER_CALL) drives the CNN families
 through Executor.run_steps — K device steps per Python dispatch via one
@@ -1011,6 +1016,85 @@ def main_ring_attention():
     }, errors)
 
 
+def main_embedding():
+    """Criteo-DLRM-style sparse embedding family (ISSUE 10): one shared
+    [ROWS, DIM] table looked up by SLOTS categorical features per example,
+    row-sharded over an fsdp mesh of every visible device, trained with
+    Adam through the SelectedRows scatter-apply path (no dense [ROWS, DIM]
+    gradient or moment update ever materializes). The JSON line reports
+    rows_touched_per_sec — the sparse-path throughput unit: ids presented
+    to the table per second — next to the table geometry, whether
+    scatter-apply was live, the densify-fallback count (must stay 0), and
+    per-shard HBM table/opt-state bytes (on an 8-device mesh per-shard is
+    total/8). No AMP: the table and its moments stay f32."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import telemetry
+    from paddle_tpu.ops import sparse_ops
+    from paddle_tpu.parallel import embedding as emb_mod
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    bsz = int(BATCH) if BATCH else 256
+    rows = int(os.environ.get("BENCH_EMB_ROWS", "1000000"))
+    dim = int(os.environ.get("BENCH_EMB_DIM", "64"))
+    slots = int(os.environ.get("BENCH_EMB_SLOTS", "26"))
+    devs = jax.devices()
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        ids = fluid.layers.data(name="ids", shape=[slots], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[rows, dim], is_sparse=True,
+            param_attr=fluid.ParamAttr(name="emb_table"))
+        flat = fluid.layers.reshape(emb, shape=[-1, slots * dim])
+        h = fluid.layers.fc(input=flat, size=256, act="relu")
+        h = fluid.layers.fc(input=h, size=64, act="relu")
+        logits = fluid.layers.fc(input=h, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(
+            loss, startup_program=startup)
+    main_prog._mesh = make_mesh((len(devs),), ("fsdp",))
+    emb_mod.shard_table(main_prog, "emb_table", "fsdp")
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, rows, (bsz, slots)).astype(np.int64)
+    lab_np = rng.integers(0, 2, (bsz, 1)).astype(np.int64)
+    feed = {"ids": jax.device_put(ids_np), "label": jax.device_put(lab_np)}
+
+    def step():
+        out, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                       return_numpy=False)
+        return out
+
+    _PERF_STEP[0] = step
+    errors = []
+    dt, done = _timed_loop(step, WARMUP, STEPS, errors)
+    s_step = dt / done
+    rows_touched = bsz * slots           # ids presented per step, pre-merge
+    per = emb_mod.per_shard_table_bytes(main_prog)
+    t = per["tables"]["emb_table"]
+    densify = telemetry.read_series("sparse_densify_fallback_total")
+    _emit({
+        "metric": "embedding_rows_touched_per_sec",
+        "value": round(rows_touched / s_step, 1),
+        "unit": "rows/sec",
+        "vs_baseline": None,   # no reference-published criteo anchor
+        "examples_per_sec": round(bsz / s_step, 1),
+        "batch": bsz, "table_rows": rows, "emb_dim": dim, "slots": slots,
+        "sparse_apply": sparse_ops.sparse_apply_enabled(),
+        "fsdp_devices": len(devs),
+        "table_bytes": t["bytes"],
+        "table_bytes_per_shard": t["per_shard_bytes"],
+        "opt_state_bytes_per_shard": t["opt_state_per_shard_bytes"],
+        "densify_fallbacks": sum(densify.values()),
+        "steps_timed": done,
+    }, errors)
+
+
 def _dispatch(mode):
     if mode == "fc":
         return main_fc()
@@ -1022,6 +1106,8 @@ def _dispatch(mode):
         return main_transformer()
     if mode == "ring_attention":
         return main_ring_attention()
+    if mode == "embedding":
+        return main_embedding()
     family, _, job = mode.partition("_")
     if family not in CNN or job not in ("", "infer"):
         raise SystemExit(f"unknown BENCH_MODE={mode}")

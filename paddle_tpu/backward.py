@@ -232,6 +232,19 @@ def append_backward(loss: Variable, parameter_list: Optional[Sequence] = None,
         ent = (p.name, g.name)
         if ent not in pairs:
             pairs.append(ent)
+    # record grads that are SelectedRows by construction (is_sparse
+    # lookup_table_grad): the overlap planner (parallel/overlap.py) must
+    # not bucket them into dense all-reduces, and can say so at PLAN time
+    # instead of discovering a sparse value at flush. Sharded tables force
+    # sparse grads too, but sharding may be annotated after backward —
+    # the planner cross-checks program._sharded_tables itself.
+    sparse_names = getattr(program, "_sparse_grad_names", None)
+    if sparse_names is None:
+        sparse_names = program._sparse_grad_names = set()
+    for op_ in block.ops:
+        if op_.type == "lookup_table_grad" and op_.attr("is_sparse", False):
+            for n in op_.output_arg_names:
+                sparse_names.add(n)
     return result
 
 

@@ -35,6 +35,7 @@ from . import telemetry
 from .framework.desc import VarType
 from .framework.framework import Program, Variable, default_main_program
 from .ops import registry
+from .ops import sparse_ops as sparse_ops_mod
 
 __all__ = [
     "CPUPlace", "TPUPlace", "CUDAPlace", "place_device",
@@ -331,11 +332,18 @@ SEQLEN_SUFFIX = "@SEQLEN"
 SEQLEN2_SUFFIX = "@SEQLEN2"   # inner lengths [B, S] of nested (level-2) LoD
 
 # ops with a native SelectedRows (sparse-rows) kernel; everything else
-# receives densified gradients. The reference registers SelectedRows
-# variants for sum/sgd/adam (sum_op.cc, sgd_op.h, adam_op.h); momentum is
-# a deliberate extension here so the default CNN optimizer also keeps
-# embedding grads sparse.
-_SPARSE_AWARE_OPS = {"sum", "sgd", "adam", "momentum"}
+# receives densified gradients (counted: sparse_densify_fallback_total).
+# The reference registers SelectedRows variants for sum/sgd/adam
+# (sum_op.cc, sgd_op.h, adam_op.h); momentum is a deliberate extension so
+# the default CNN optimizer also keeps embedding grads sparse. The
+# optimizer entries come from the sparse-capable table in
+# ops/sparse_ops.py, which tools/check_registry.py pins against the
+# actual lowerings. fused_sparse_* are the trace-time scatter-apply
+# buckets (ops/fusion.py) — their Grad inputs must cross the boundary
+# still sparse for the member kernels to re-execute.
+_SPARSE_AWARE_OPS = frozenset(
+    {"sum"} | set(sparse_ops_mod.SPARSE_APPLY_OPS)
+    | {"fused_sparse_" + t for t in sparse_ops_mod.SPARSE_APPLY_OPS})
 
 
 def _bucket_len(n: int) -> int:
@@ -1481,11 +1489,24 @@ class Executor:
         if op.type not in _SPARSE_AWARE_OPS:
             # SelectedRows grads (sparse embedding path) densify at the
             # boundary of any op without a sparse kernel — the analogue of
-            # the reference's per-kernel SelectedRows dispatch
+            # the reference's per-kernel SelectedRows dispatch. Counted:
+            # this is the invisible perf cliff sparse_densify_fallback_total
+            # exists to surface (a clip/regularizer/cast in the grad chain
+            # silently turns O(rows) into O(table)).
             from .ops.common import SelectedRowsVal
-            ins = {slot: [v.to_dense() if isinstance(v, SelectedRowsVal)
-                          else v for v in vals]
-                   for slot, vals in ins.items()}
+            newins = {}
+            hit = False
+            for slot, vals in ins.items():
+                conv = []
+                for v in vals:
+                    if isinstance(v, SelectedRowsVal):
+                        hit = True
+                        v = v.to_dense()
+                    conv.append(v)
+                newins[slot] = conv
+            if hit:
+                sparse_ops_mod.count_densify(op.type, "sparse_unaware_op")
+            ins = newins
         t0 = time.perf_counter() if _BENCHMARK and _EAGER else None
         try:
             # the scope lands in every emitted HLO instruction's
@@ -1621,7 +1642,7 @@ class Executor:
             layout_mod.canonicalize(ctx.layouts, env,
                                     list(fetch_names) + list(persist_out))
         from .ops.common import maybe_dense
-        fetch = [maybe_dense(env[n]) for n in fetch_names]
+        fetch = [maybe_dense(env[n], count_as="fetch") for n in fetch_names]
         # lengths side channel for fetched sequence vars, so run() can
         # rebuild LoDTensors (padded_to_pack) when return_numpy=False
         fetch_lens = {n: env[n + SEQLEN_SUFFIX] for n in fetch_names
@@ -1664,6 +1685,16 @@ class Executor:
         param_specs = getattr(program, "_param_shardings", {})
         seed = program.random_seed or 12345
 
+        def _state_spec(n):
+            # accumulators of a row-sharded embedding table inherit the
+            # table's sharding (parallel/embedding.resolve_state_spec) so
+            # adam moments of a 1M-row table never replicate per device
+            spec = param_specs.get(n)
+            if spec is None and getattr(program, "_sharded_tables", None):
+                from .parallel import embedding as embedding_mod
+                spec = embedding_mod.resolve_state_spec(program, n)
+            return spec
+
         def fn(feed_vals, state_vals, rng_counter):
             # key derivation INSIDE the jit: the per-step fold_in costs
             # nothing host-side (eagerly it was ~3ms/step of tiny
@@ -1682,7 +1713,7 @@ class Executor:
                 from .parallel._collectives import coll_scope
                 pinned = {}
                 for n, v in new_state.items():
-                    spec = param_specs.get(n)
+                    spec = _state_spec(n)
                     sh = NamedSharding(mesh, PartitionSpec(*spec)) if spec \
                         else NamedSharding(mesh, PartitionSpec())
                     try:
@@ -1720,11 +1751,18 @@ class Executor:
         repl = NamedSharding(mesh, PartitionSpec())
 
         # per-parameter PartitionSpec annotations (tensor / ZeRO
-        # sharding, parallel/tensor_parallel.py); unannotated state is
+        # sharding, parallel/tensor_parallel.py); sharded-table optimizer
+        # accumulators inherit their table's row sharding
+        # (parallel/embedding.resolve_state_spec); everything else is
         # replicated and XLA GSPMD partitions the consumers
         state_shardings = {}
+        has_tables = bool(getattr(program, "_sharded_tables", None))
+        if has_tables:
+            from .parallel import embedding as embedding_mod
         for n in state_names:
             spec = param_specs.get(n)
+            if spec is None and has_tables:
+                spec = embedding_mod.resolve_state_spec(program, n)
             state_shardings[n] = repl if spec is None else \
                 NamedSharding(mesh, PartitionSpec(*spec))
 
@@ -1879,7 +1917,7 @@ class Executor:
                                     list(fetch_names) + list(persist_out)
                                     + list(state_vals))
         from .ops.common import maybe_dense
-        fetch = [maybe_dense(env[n]) for n in fetch_names]
+        fetch = [maybe_dense(env[n], count_as="fetch") for n in fetch_names]
         fetch_lens = {n: env[n + SEQLEN_SUFFIX] for n in fetch_names
                       if n + SEQLEN_SUFFIX in env}
         for n in fetch_names:

@@ -88,9 +88,21 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
-              padding_idx=None, param_attr=None, dtype="float32"):
-    """Embedding lookup (reference nn.py:188). is_sparse is accepted for
-    source compat; on TPU the grad is a dense scatter-add fused by XLA."""
+              padding_idx=None, param_attr=None, dtype="float32",
+              shard_axis=None):
+    """Embedding lookup (reference nn.py:188).
+
+    is_sparse=True keeps the gradient a SelectedRows value end-to-end:
+    lookup_table_grad emits (rows, values) and the sgd/momentum/adam
+    scatter-apply kernels (ops/sparse_ops.py) update only the touched
+    rows — the table never materializes a dense gradient.
+
+    is_distributed (the reference's pserver-sharded table) maps to
+    row-sharding the table over the program's mesh: the table partitions
+    over `shard_axis` (default PADDLE_TPU_EMB_SHARD_AXIS, "fsdp") and
+    lookups mod-shard-route ids under pd.coll.emb_lookup. Pass
+    shard_axis explicitly (an axis name or tuple) to shard without the
+    is_distributed flag."""
     helper = LayerHelper("embedding", param_attr=param_attr)
     w = helper.create_parameter(attr=helper.param_attr, shape=size,
                                 dtype=dtype, is_bias=False)
@@ -101,6 +113,9 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
                      inputs={"W": [w], "Ids": [input]},
                      outputs={"Out": [tmp]},
                      attrs={"is_sparse": is_sparse, "padding_idx": padding_idx})
+    if shard_axis is not None or is_distributed:
+        from ..parallel import embedding as embedding_mod
+        embedding_mod.shard_table(helper.main_program, w.name, shard_axis)
     return tmp
 
 

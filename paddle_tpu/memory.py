@@ -459,19 +459,39 @@ def classify(program, state_vals: Dict[str, Any],
     metadata: parameters are the block's Parameter vars, every other
     persistable state (optimizer accumulators like `<param>_velocity_*`,
     LR vars, BN stats) is opt_state. Byte counts come from avals only, so
-    donated arrays are safe to classify after the step ran."""
+    donated arrays are safe to classify after the step ran.
+
+    Bytes are PER-DEVICE: vars sharded under the program's mesh (row-
+    sharded embedding tables and their accumulators, tensor/ZeRO-sharded
+    params) divide by their shard factor
+    (parallel/embedding.state_shard_factor), so the hbm_class_bytes
+    breakdown and HeadroomModel inputs describe what one device actually
+    holds — the number that OOMs."""
     key = (id(program), getattr(program, "_version", 0))
     hit = _CLASS_CACHE.get(key)
     if hit is None or hit[0] is not program:
         params = {p.name for p in program.global_block().all_parameters()}
-        _CLASS_CACHE[key] = (program, params)
+        factors: Dict[str, int] = {}
+        if getattr(program, "_mesh", None) is not None and (
+                getattr(program, "_param_shardings", None)
+                or getattr(program, "_sharded_tables", None)):
+            from .parallel import embedding as embedding_mod
+            for n in state_vals:
+                f = embedding_mod.state_shard_factor(program, n)
+                if f > 1:
+                    factors[n] = f
+        _CLASS_CACHE[key] = (program, params, factors)
         while len(_CLASS_CACHE) > 64:
             _CLASS_CACHE.pop(next(iter(_CLASS_CACHE)))
         hit = _CLASS_CACHE[key]
-    params = hit[1]
+    params, factors = hit[1], hit[2]
     out = {"params": 0, "opt_state": 0, "feeds": 0}
     for n, v in state_vals.items():
-        out["params" if n in params else "opt_state"] += nbytes_of(v)
+        b = nbytes_of(v)
+        f = factors.get(n, 1)
+        if f > 1:
+            b = -(-b // f)   # ceil: XLA pads uneven shards
+        out["params" if n in params else "opt_state"] += b
     for v in feed_vals.values():
         out["feeds"] += nbytes_of(v)
     return out
@@ -722,7 +742,14 @@ class HeadroomModel:
     per-sample buffer (feeds, activations, logits) scales with b while
     params/opt-state/code do not; XLA padding and fusion keep it only
     approximately linear — which is why what_if() validates the
-    extrapolation against a fresh analysis at the predicted batch."""
+    extrapolation against a fresh analysis at the predicted batch.
+
+    For sharded programs both inputs are per-device numbers: the static
+    analyses XLA returns for an SPMD module are post-partitioning, and
+    classify() divides sharded state (row-sharded embedding tables and
+    their optimizer accumulators included) by its shard factor — so
+    fixed_bytes carries the per-shard table + opt-state footprint and
+    max_batch() answers against one device's budget, the one that OOMs."""
 
     def __init__(self, fixed_bytes: float, per_item_bytes: float,
                  points: Optional[Sequence[Tuple[int, int]]] = None):

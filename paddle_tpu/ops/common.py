@@ -33,8 +33,17 @@ jax.tree_util.register_pytree_node(
     lambda h, ch: SelectedRowsVal(ch[0], ch[1], h))
 
 
-def maybe_dense(v):
-    return v.to_dense() if isinstance(v, SelectedRowsVal) else v
+def maybe_dense(v, count_as: Optional[str] = None):
+    """Densify a SelectedRowsVal (identity otherwise). Pass `count_as`
+    (a site label like "fetch") to record the densification in
+    sparse_densify_fallback_total — silent call sites are the perf
+    cliffs ISSUE 10's counters exist to surface."""
+    if isinstance(v, SelectedRowsVal):
+        if count_as is not None:
+            from . import sparse_ops
+            sparse_ops.count_densify(count_as, "densified_at_" + count_as)
+        return v.to_dense()
+    return v
 
 
 def merge_selected_rows(sr: "SelectedRowsVal"):

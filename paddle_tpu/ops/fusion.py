@@ -87,6 +87,7 @@ OPTIMIZER_BUCKET_OPS = frozenset({"sgd", "momentum", "adam"})
 FUSED_OP_TYPES = (
     "fused_conv_bn_act", "fused_bn_act", "fused_fc_act", "fused_chain",
     "fused_sgd", "fused_momentum", "fused_adam",
+    "fused_sparse_sgd", "fused_sparse_momentum", "fused_sparse_adam",
 )
 
 # per-param input slots / shared input slots / per-param output slots
@@ -327,11 +328,15 @@ def _window_synth(members, type_, group, elide=()):
                            getattr(members[0], "creation_site", None))
 
 
-def _bucket_synth(group, members, t):
-    """Fused optimizer op over a dense same-dtype sub-bucket: slots keep
-    their natural names with one entry per member (uniform across
-    members), shared slots (LR, beta pows) collapse to one."""
-    key = tuple(id(m) for m in members)
+def _bucket_synth(group, members, t, prefix="fused_"):
+    """Fused optimizer op over a same-dtype sub-bucket: slots keep their
+    natural names with one entry per member (uniform across members),
+    shared slots (LR, beta pows) collapse to one. prefix="fused_sparse_"
+    builds the scatter-apply bucket (members re-executed by
+    _sparse_bucket_lower under one scope); the prefix is part of the
+    cache key because a member set can flip dense<->sparse across traces
+    (PADDLE_TPU_SPARSE_APPLY toggles between compiles)."""
+    key = (prefix,) + tuple(id(m) for m in members)
     hit = group.cache.get(key)
     if hit is not None:
         return hit
@@ -343,7 +348,9 @@ def _bucket_synth(group, members, t):
     outputs = {s: [_first(m.desc.output(s)) for m in members] for s in outs}
     attrs = dict(members[0].desc.attrs)
     attrs["__fusion_group__"] = group
-    desc = OpDesc(type="fused_" + t, inputs=inputs, outputs=outputs,
+    if prefix == "fused_sparse_":
+        attrs["__sparse_members__"] = tuple(members)
+    desc = OpDesc(type=prefix + t, inputs=inputs, outputs=outputs,
                   attrs=attrs)
     op = _synth_operator(getattr(members[0], "block", None), desc,
                          getattr(members[0], "creation_site", None))
@@ -395,17 +402,30 @@ def execute_group(executor, ctx, group: Group, env, protected=()):
 
 
 def _execute_opt_bucket(executor, ctx, group: Group, env):
+    from . import sparse_ops
     t = group.members[0].type
     specs = getattr(ctx.program, "_param_shardings", None) or {}
+    tables = getattr(ctx.program, "_sharded_tables", None) or {}
     dense: List[Any] = []
+    sparse: List[Any] = []
     for m in group.members:
         gname = _first(m.desc.input("Grad"))
         pname = _first(m.desc.input("Param"))
         if isinstance(env.get(gname), SelectedRowsVal):
-            # sparse fast path stays per-param (reference: only a few ops
-            # register SelectedRows kernels; densifying would be O(vocab))
-            _count(ctx, "sparse_grad")
-            executor._exec_op(ctx, m, env)
+            # sparse grads never join the dense concat (densifying would
+            # be O(vocab)); when the op has a scatter-apply kernel they
+            # get their own per-dtype fused_sparse bucket below. The
+            # reasons distinguish "kept sparse on purpose" (dashboards
+            # should not read the sparse path as a perf cliff) from a
+            # genuinely unsupported combination.
+            if sparse_ops.sparse_apply_enabled() \
+                    and t in sparse_ops.SPARSE_APPLY_OPS:
+                _count(ctx, "sharded_table_sparse_path" if pname in tables
+                       else "sparse_grad_handled")
+                sparse.append(m)
+            else:
+                _count(ctx, "sparse_grad_unsupported")
+                executor._exec_op(ctx, m, env)
         elif pname in specs:
             # explicitly sharded params stay per-param: concatenating
             # differently-sharded buffers would force GSPMD gathers
@@ -432,6 +452,22 @@ def _execute_opt_bucket(executor, ctx, group: Group, env):
                 executor._exec_op(ctx, m, env)
             continue
         executor._exec_op(ctx, _bucket_synth(group, ms, t), env)
+    # scatter-apply members bucket per param dtype, mirroring the dense
+    # buckets: one fused_sparse_<t> unit per dtype (the scatters stay
+    # per-table — tables differ in height — but share one scope/observer
+    # entry so attribution sees one apply unit, not N stragglers)
+    sbuckets: Dict[str, List[Any]] = {}
+    for m in sparse:
+        p = env.get(_first(m.desc.input("Param")))
+        sbuckets.setdefault(str(getattr(p, "dtype", None)), []).append(m)
+    for sig in sorted(sbuckets):
+        ms = sbuckets[sig]
+        if len(ms) < 2:
+            for m in ms:
+                executor._exec_op(ctx, m, env)
+            continue
+        executor._exec_op(
+            ctx, _bucket_synth(group, ms, t, prefix="fused_sparse_"), env)
 
 
 # --- compose machinery --------------------------------------------------
@@ -764,6 +800,20 @@ def _lower_fused_adam(ctx, op_, ins):
             "Moment2Out": _split(m2o, shapes)}
 
 
+def _sparse_bucket_lower(ctx, op_, ins):
+    """Fused scatter-apply bucket: run each member optimizer op (whose
+    lowering hits the sparse_ops scatter-apply kernel, including the
+    sharded-table pin-back) under ONE fused scope/observer entry — the
+    values are bitwise identical to the per-param sparse path, only the
+    attribution unit changes, mirroring _compose_lower for dense windows."""
+    env = ctx.env
+    with _muted_observers():
+        for m in op_.attr("__sparse_members__"):
+            ctx.executor._exec_op(ctx, m, env)
+    _freeze(ctx, env, _out_names(op_))
+    return _collect(op_, env)
+
+
 # --- registration -------------------------------------------------------
 
 register("fused_conv_bn_act", lower=_conv_bn_act_lower, grad=NO_GRAD)
@@ -773,6 +823,9 @@ register("fused_chain", lower=_compose_lower, grad=NO_GRAD)
 register("fused_sgd", lower=_lower_fused_sgd, grad=NO_GRAD)
 register("fused_momentum", lower=_lower_fused_momentum, grad=NO_GRAD)
 register("fused_adam", lower=_lower_fused_adam, grad=NO_GRAD)
+register("fused_sparse_sgd", lower=_sparse_bucket_lower, grad=NO_GRAD)
+register("fused_sparse_momentum", lower=_sparse_bucket_lower, grad=NO_GRAD)
+register("fused_sparse_adam", lower=_sparse_bucket_lower, grad=NO_GRAD)
 
 # fused ops manage layout tags themselves (member-level prepass/
 # tag_outputs run inside the lowerings); without this the executor's

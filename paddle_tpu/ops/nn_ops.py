@@ -187,13 +187,21 @@ def _lookup_infer(op_, block):
 
 @op("lookup_table", infer_shape=_lookup_infer, non_diff_inputs=("Ids",))
 def _lookup_table(ctx, op_, ins):
+    from . import sparse_ops
     w = jnp.asarray(ins["W"][0])
     ids = jnp.asarray(ins["Ids"][0])
     squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1
     if squeeze_last:
         ids = ids.reshape(ids.shape[:-1])
     pad = op_.attr("padding_idx", -1)
-    out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    ids32 = ids.astype(jnp.int32)
+    wname = (op_.input("W") or [None])[0]
+    if wname and sparse_ops.table_axes(ctx.program, wname) is not None:
+        # row-sharded table: pin + gather under pd.coll.emb_lookup so
+        # GSPMD mod-shard-routes the ids instead of all-gathering rows
+        out = sparse_ops.sharded_lookup(ctx.program, wname, w, ids32)
+    else:
+        out = jnp.take(w, ids32, axis=0)
     if pad is not None and pad >= 0:
         out = jnp.where((ids == pad)[..., None], 0.0, out)
     return {"Out": [out]}
@@ -217,7 +225,20 @@ def _lookup_table_grad(ctx, op_, ins):
     flat_g = g.reshape(-1, g.shape[-1]).astype(w.dtype)
     if pad is not None and pad >= 0:
         flat_g = jnp.where((flat_ids == pad)[:, None], 0.0, flat_g)
-    if op_.attr("is_sparse", False):
+    from . import sparse_ops
+    wname = (op_.input("W") or [None])[0]
+    sharded = (wname is not None
+               and sparse_ops.table_axes(ctx.program, wname) is not None)
+    if op_.attr("is_sparse", False) or sharded:
+        # sharded tables force the sparse grad even without is_sparse: a
+        # dense [V, D] cotangent would materialize the whole table per
+        # device before the optimizer ever saw it
+        if sharded and not op_.attr("is_sparse", False):
+            sparse_ops.note_once(
+                f"forced_sparse:{wname}",
+                f"lookup_table_grad for row-sharded table '{wname}' "
+                f"emits a SelectedRows gradient (is_sparse forced on): "
+                f"a dense gradient would materialize the full table.")
         return {"W@GRAD": [SelectedRowsVal(flat_ids, flat_g, w.shape[0])]}
     dense = jnp.zeros_like(w).at[flat_ids].add(flat_g)
     return {"W@GRAD": [dense]}

@@ -13,8 +13,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .registry import NO_GRAD, op
-from .common import (SelectedRowsVal, maybe_dense, merge_selected_rows,
-                     in_var, set_out)
+from . import sparse_ops
+from .common import SelectedRowsVal, maybe_dense, in_var, set_out
 
 
 def _param_out_infer(*pairs):
@@ -30,14 +30,33 @@ def _lr(ins):
     return jnp.asarray(ins["LearningRate"][0]).reshape(())
 
 
-def _param_grad(ins):
+def _param_grad(ins, op_type=None):
     """(param, grad) with the grad upcast to the param dtype: fp32
     master-weight updates under AMP O2 receive bf16 grads, which must be
     upcast before any arithmetic so lr*g and accumulators stay full
-    precision. SelectedRows grads densify here; sgd has its own sparse
-    fast path (reference: only sgd/adam register SelectedRows kernels)."""
+    precision. SelectedRows grads densify here, COUNTED: pass the op type
+    so `sparse_densify_fallback_total{op,reason}` attributes the cliff —
+    `no_sparse_kernel` for optimizers outside sparse_ops.SPARSE_APPLY_OPS
+    (the reference registers SelectedRows kernels only for sgd/momentum/
+    adam), `gated_off` when PADDLE_TPU_SPARSE_APPLY=0 disabled a capable
+    one."""
     p = jnp.asarray(ins["Param"][0])
-    return p, jnp.asarray(maybe_dense(ins["Grad"][0])).astype(p.dtype)
+    g0 = ins["Grad"][0]
+    if isinstance(g0, SelectedRowsVal) and op_type is not None:
+        reason = ("gated_off" if op_type in sparse_ops.SPARSE_APPLY_OPS
+                  else "no_sparse_kernel")
+        sparse_ops.count_densify(op_type, reason)
+    return p, jnp.asarray(maybe_dense(g0)).astype(p.dtype)
+
+
+def _sparse_ready(ins):
+    return (isinstance(ins["Grad"][0], SelectedRowsVal)
+            and sparse_ops.sparse_apply_enabled())
+
+
+def _pname(op_):
+    names = op_.input("Param")
+    return names[0] if names else None
 
 
 # Dense update math, shared by the per-param lowerings below and the
@@ -70,14 +89,15 @@ def adam_dense(p, g, m1, m2, lr, b1, b2, eps, b1p, b2p):
 
 @op("sgd", grad=NO_GRAD, infer_shape=_param_out_infer(("Param", "ParamOut")))
 def _sgd(ctx, op_, ins):
-    g0 = ins["Grad"][0]
-    if isinstance(g0, SelectedRowsVal):
-        # sparse update: scatter-add only the looked-up rows (reference
-        # sgd_op.h SelectedRows branch / selected_rows_functor.cc)
+    if _sparse_ready(ins):
+        # scatter-apply kernel (reference sgd_op.h SelectedRows branch /
+        # selected_rows_functor.cc), merge-first so duplicate ids sum
+        # exactly like the dense accumulation
         p = jnp.asarray(ins["Param"][0])
-        upd = (-_lr(ins) * g0.values).astype(p.dtype)
-        return {"ParamOut": [p.at[g0.rows].add(upd)]}
-    p, g = _param_grad(ins)
+        po = sparse_ops.sgd_apply(p, _lr(ins), ins["Grad"][0])
+        po = sparse_ops.pin_table(ctx.program, _pname(op_), po)
+        return {"ParamOut": [po]}
+    p, g = _param_grad(ins, "sgd")
     return {"ParamOut": [sgd_dense(p, g, _lr(ins))]}
 
 
@@ -86,22 +106,17 @@ def _sgd(ctx, op_, ins):
                                  ("Velocity", "VelocityOut")))
 def _momentum(ctx, op_, ins):
     mu = op_.attr("mu")
-    g0 = ins["Grad"][0]
-    if isinstance(g0, SelectedRowsVal):
-        # SelectedRows fast path: velocity decays + param moves only on
+    if _sparse_ready(ins):
+        # scatter-apply kernel: velocity decays + param moves only on
         # the gradient's rows (lazy semantics matching sparse adam below)
         p = jnp.asarray(ins["Param"][0])
         v = jnp.asarray(ins["Velocity"][0])
-        rows, gv = merge_selected_rows(g0)
-        gv = gv.astype(p.dtype)
-        v_out = mu * v[rows] + gv
-        if op_.attr("use_nesterov", False):
-            p_out = p[rows] - _lr(ins) * (gv + mu * v_out)
-        else:
-            p_out = p[rows] - _lr(ins) * v_out
-        return {"ParamOut": [p.at[rows].set(p_out, mode="drop")],
-                "VelocityOut": [v.at[rows].set(v_out, mode="drop")]}
-    p, g = _param_grad(ins)
+        po, vo = sparse_ops.momentum_apply(
+            p, v, _lr(ins), mu, op_.attr("use_nesterov", False),
+            ins["Grad"][0])
+        po, vo = sparse_ops.pin_table(ctx.program, _pname(op_), po, vo)
+        return {"ParamOut": [po], "VelocityOut": [vo]}
+    p, g = _param_grad(ins, "momentum")
     v = jnp.asarray(ins["Velocity"][0])
     p_out, v_out = momentum_dense(p, g, v, _lr(ins), mu,
                                   op_.attr("use_nesterov", False))
@@ -117,9 +132,8 @@ def _adam(ctx, op_, ins):
     eps = op_.attr("epsilon", 1e-8)
     b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
     b2p = jnp.asarray(ins["Beta2Pow"][0]).reshape(())
-    g0 = ins["Grad"][0]
-    if isinstance(g0, SelectedRowsVal):
-        # SelectedRows fast path (reference adam_op.h SparseAdamFunctor):
+    if _sparse_ready(ins):
+        # scatter-apply kernel (reference adam_op.h SparseAdamFunctor):
         # moments/param update only the gradient's rows; untouched rows
         # keep stale moments, exactly like the reference. O(K*D) instead
         # of the O(V*D) densified update — the difference between an
@@ -127,19 +141,13 @@ def _adam(ctx, op_, ins):
         p = jnp.asarray(ins["Param"][0])
         m1 = jnp.asarray(ins["Moment1"][0])
         m2 = jnp.asarray(ins["Moment2"][0])
-        rows, gv = merge_selected_rows(g0)
-        gv = gv.astype(p.dtype)
-        m1r = m1[rows]
-        m2r = m2[rows]
-        m1o = b1 * m1r + (1 - b1) * gv
-        m2o = b2 * m2r + (1 - b2) * gv * gv
-        lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
-        po = p[rows] - lr * m1o / (jnp.sqrt(m2o) + eps)
-        # padded slots carry row==height: out-of-range scatters drop
-        return {"ParamOut": [p.at[rows].set(po, mode="drop")],
-                "Moment1Out": [m1.at[rows].set(m1o, mode="drop")],
-                "Moment2Out": [m2.at[rows].set(m2o, mode="drop")]}
-    p, g = _param_grad(ins)
+        po, m1o, m2o = sparse_ops.adam_apply(
+            p, m1, m2, _lr(ins), b1, b2, eps, b1p, b2p, ins["Grad"][0])
+        po, m1o, m2o = sparse_ops.pin_table(
+            ctx.program, _pname(op_), po, m1o, m2o)
+        return {"ParamOut": [po], "Moment1Out": [m1o],
+                "Moment2Out": [m2o]}
+    p, g = _param_grad(ins, "adam")
     m1 = jnp.asarray(ins["Moment1"][0])
     m2 = jnp.asarray(ins["Moment2"][0])
     po, m1o, m2o = adam_dense(p, g, m1, m2, _lr(ins), b1, b2, eps,
@@ -151,7 +159,7 @@ def _adam(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut"),
                                  ("InfNorm", "InfNormOut")))
 def _adamax(ctx, op_, ins):
-    p, g = _param_grad(ins)
+    p, g = _param_grad(ins, op_.type)
     m = jnp.asarray(ins["Moment"][0])
     u = jnp.asarray(ins["InfNorm"][0])
     b1p = jnp.asarray(ins["Beta1Pow"][0]).reshape(())
@@ -167,7 +175,7 @@ def _adamax(ctx, op_, ins):
 @op("adagrad", grad=NO_GRAD,
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
 def _adagrad(ctx, op_, ins):
-    p, g = _param_grad(ins)
+    p, g = _param_grad(ins, op_.type)
     m = jnp.asarray(ins["Moment"][0])
     eps = op_.attr("epsilon", 1e-6)
     mo = m + g * g
@@ -178,7 +186,7 @@ def _adagrad(ctx, op_, ins):
 @op("decayed_adagrad", grad=NO_GRAD,
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
 def _decayed_adagrad(ctx, op_, ins):
-    p, g = _param_grad(ins)
+    p, g = _param_grad(ins, op_.type)
     m = jnp.asarray(ins["Moment"][0])
     decay = op_.attr("decay", 0.95)
     eps = op_.attr("epsilon", 1e-6)
@@ -192,7 +200,7 @@ def _decayed_adagrad(ctx, op_, ins):
                                  ("AvgSquaredGrad", "AvgSquaredGradOut"),
                                  ("AvgSquaredUpdate", "AvgSquaredUpdateOut")))
 def _adadelta(ctx, op_, ins):
-    p, g = _param_grad(ins)
+    p, g = _param_grad(ins, op_.type)
     ag = jnp.asarray(ins["AvgSquaredGrad"][0])
     au = jnp.asarray(ins["AvgSquaredUpdate"][0])
     rho = op_.attr("rho", 0.95)
@@ -208,7 +216,7 @@ def _adadelta(ctx, op_, ins):
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut"),
                                  ("MeanSquare", "MeanSquareOut")))
 def _rmsprop(ctx, op_, ins):
-    p, g = _param_grad(ins)
+    p, g = _param_grad(ins, op_.type)
     mom = jnp.asarray(ins["Moment"][0])
     ms = jnp.asarray(ins["MeanSquare"][0])
     rho = op_.attr("decay", 0.9)
@@ -224,7 +232,7 @@ def _rmsprop(ctx, op_, ins):
                                  ("SquaredAccumulator", "SquaredAccumOut"),
                                  ("LinearAccumulator", "LinearAccumOut")))
 def _ftrl(ctx, op_, ins):
-    p, g = _param_grad(ins)
+    p, g = _param_grad(ins, op_.type)
     sq = jnp.asarray(ins["SquaredAccumulator"][0])
     lin = jnp.asarray(ins["LinearAccumulator"][0])
     l1 = op_.attr("l1", 0.0)
@@ -250,7 +258,7 @@ def _ftrl(ctx, op_, ins):
 @op("proximal_gd", grad=NO_GRAD,
     infer_shape=_param_out_infer(("Param", "ParamOut")))
 def _proximal_gd(ctx, op_, ins):
-    p, g = _param_grad(ins)
+    p, g = _param_grad(ins, op_.type)
     l1 = op_.attr("l1", 0.0)
     l2 = op_.attr("l2", 0.0)
     lr = _lr(ins)
@@ -263,7 +271,7 @@ def _proximal_gd(ctx, op_, ins):
 @op("proximal_adagrad", grad=NO_GRAD,
     infer_shape=_param_out_infer(("Param", "ParamOut"), ("Moment", "MomentOut")))
 def _proximal_adagrad(ctx, op_, ins):
-    p, g = _param_grad(ins)
+    p, g = _param_grad(ins, op_.type)
     m = jnp.asarray(ins["Moment"][0])
     l1 = op_.attr("l1", 0.0)
     l2 = op_.attr("l2", 0.0)

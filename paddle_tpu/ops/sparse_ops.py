@@ -1,0 +1,244 @@
+"""Sparse-embedding kernels: SelectedRows end-to-end (ISSUE 10 tentpole).
+
+The reference framework's SelectedRows exists for recommender-scale
+embedding tables (reference: framework/selected_rows.h:19,
+operators/math/selected_rows_functor.cc): a step's cost must scale with
+*rows touched*, not table size. This module is the TPU-native kernel
+layer for that contract:
+
+  * `SPARSE_APPLY_OPS` — the sparse-capable optimizer table (the analogue
+    of the reference's per-op SelectedRows kernel registrations; pinned
+    against ops/optimizer_ops.py by tools/check_registry.py).
+  * `sgd_apply` / `momentum_apply` / `adam_apply` — the scatter-apply
+    kernels: merge duplicate rows (jax.ops.segment_sum with static
+    num_segments, so dedup compiles into the step), gather the touched
+    rows of param + accumulators, run the SAME `*_dense` update math
+    from ops/optimizer_ops.py on the gathered [K, D] slab ("in-register"
+    update), and scatter the results back with out-of-range drop. A
+    1M x 64 table never materializes a dense gradient or a dense
+    optimizer-state update.
+  * `sharded_lookup` — `lookup_table` on a row-sharded table: the table
+    is pinned to its `NamedSharding` under the `pd.coll.emb_lookup`
+    scope (fleet.py attributes the routing collectives to it) and the
+    static-shape gather lowers through GSPMD's indexed-dim partitioning:
+    each shard gathers the ids it owns (div/mod routing against the
+    shard's row range) and one cross-shard combine assembles the
+    off-shard rows — communication O(ids * D), independent of table
+    height.
+  * Telemetry: `sparse_apply_rows_total{op}` (static rows per traced
+    step per apply site) and `sparse_densify_fallback_total{op,reason}`
+    — every place a SelectedRows gradient silently densified now counts
+    and warns once, so the perf cliff is visible instead of invisible.
+
+Env: `PADDLE_TPU_SPARSE_APPLY=0` disables the scatter-apply kernels
+(gradients densify at the optimizer, counted under reason `gated_off`) —
+the bisection baseline for parity debugging. Read at trace time.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .common import SelectedRowsVal, merge_selected_rows
+
+__all__ = [
+    "SPARSE_APPLY_OPS", "sparse_apply_enabled", "count_densify",
+    "count_apply_rows", "table_axes", "table_sharding", "shard_factor",
+    "sharded_lookup", "pin_table", "sgd_apply", "momentum_apply",
+    "adam_apply",
+]
+
+# Optimizer ops with a scatter-apply (SelectedRows) kernel — the sparse-
+# capable table. The reference registers SelectedRows kernels for exactly
+# this family (sgd_op.h, momentum extension, adam_op.h SparseAdamFunctor);
+# everything else densifies and is counted. tools/check_registry.py pins
+# this tuple against the actual lowerings in ops/optimizer_ops.py and
+# against executor._SPARSE_AWARE_OPS.
+SPARSE_APPLY_OPS: Tuple[str, ...] = ("sgd", "momentum", "adam")
+
+
+def sparse_apply_enabled() -> bool:
+    """PADDLE_TPU_SPARSE_APPLY gate, read at trace time (default on)."""
+    return os.environ.get("PADDLE_TPU_SPARSE_APPLY", "1") == "1"
+
+
+_WARNED: set = set()
+
+
+def count_densify(op: str, reason: str, amount: int = 1, *,
+                  log: bool = True):
+    """sparse_densify_fallback_total{op,reason} + a once-per-(op,reason)
+    warning: a SelectedRows gradient just became a table-sized dense
+    tensor, turning an O(rows-touched) update into an O(table-rows) one."""
+    from .. import telemetry
+    telemetry.counter(
+        "sparse_densify_fallback_total",
+        "SelectedRows gradients densified to a full table-sized tensor, "
+        "by consuming op and reason (sparse-path perf cliffs made visible)",
+        labels=("op", "reason")).labels(op=op, reason=reason).inc(amount)
+    if log and (op, reason) not in _WARNED:
+        _WARNED.add((op, reason))
+        warnings.warn(
+            f"SelectedRows gradient densified at '{op}' ({reason}): this "
+            f"update now costs O(table rows), not O(rows touched). "
+            f"sgd/momentum/adam keep sparse gradients sparse "
+            f"(PADDLE_TPU_SPARSE_APPLY=1); other consumers densify.",
+            stacklevel=3)
+
+
+def note_once(key: str, msg: str):
+    """One warning per process for a non-counter sparse-path note."""
+    if key not in _WARNED:
+        _WARNED.add(key)
+        warnings.warn(msg, stacklevel=3)
+
+
+def count_apply_rows(op: str, rows: int):
+    """sparse_apply_rows_total{op}: rows scatter-applied per traced step
+    at this apply site (K is a static shape, counted at trace time — one
+    compiled step applies exactly this many scatter slots per run)."""
+    from .. import telemetry
+    telemetry.counter(
+        "sparse_apply_rows_total",
+        "rows scatter-applied per traced step by sparse optimizer "
+        "kernels (static K per apply site, counted at trace time)",
+        labels=("op",)).labels(op=op).inc(int(rows))
+
+
+# --- sharded-table plumbing ------------------------------------------------
+
+def table_axes(program, wname: str) -> Optional[Tuple[str, ...]]:
+    """Mesh axis names sharding dim 0 (the row dim) of parameter `wname`,
+    or None when the table is unsharded / the program has no mesh / the
+    annotation names axes the mesh lacks. Dim-0 entries may be a single
+    axis ("fsdp") or an axis tuple (("fsdp", "tp") — SNIPPETS.md [2]
+    SpecLayout.embeddings)."""
+    spec = (getattr(program, "_param_shardings", {}) or {}).get(wname)
+    mesh = getattr(program, "_mesh", None)
+    if not spec or mesh is None:
+        return None
+    first = spec[0]
+    if not first:
+        return None
+    axes = tuple(first) if isinstance(first, (tuple, list)) else (first,)
+    if not all(a in mesh.axis_names for a in axes):
+        return None
+    return axes
+
+
+def table_sharding(program, wname: str):
+    """NamedSharding for a row-sharded table, or None."""
+    if table_axes(program, wname) is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = (getattr(program, "_param_shardings", {}) or {})[wname]
+    return NamedSharding(getattr(program, "_mesh"), PartitionSpec(*spec))
+
+
+def shard_factor(program, wname: str) -> int:
+    """How many ways the table's rows split (product of its dim-0 mesh
+    axis sizes); 1 for unsharded tables."""
+    axes = table_axes(program, wname) or ()
+    mesh = getattr(program, "_mesh", None)
+    sizes = dict(mesh.shape) if mesh is not None else {}
+    f = 1
+    for a in axes:
+        f *= int(sizes.get(a, 1))
+    return f
+
+
+def sharded_lookup(program, wname: str, w, ids):
+    """Embedding gather on a row-sharded table. The table is pinned to
+    its NamedSharding inside the `pd.coll.emb_lookup` scope so (a) GSPMD
+    partitions the gather on the indexed dim — each shard serves the ids
+    in its own row range and one cross-shard combine assembles the
+    off-shard rows, never an all-gather of the table — and (b) fleet.py's
+    collective table attributes the routing traffic to this site."""
+    from ..parallel._collectives import coll_scope
+    sh = table_sharding(program, wname)
+    with coll_scope("emb_lookup"):
+        if sh is not None:
+            try:
+                w = jax.lax.with_sharding_constraint(w, sh)
+            except (TypeError, ValueError):
+                pass
+        return jnp.take(w, ids, axis=0)
+
+
+def pin_table(program, pname: str, *vals):
+    """Re-pin table-shaped outputs (param + accumulators) to the table's
+    row sharding after a scatter-apply, under the `pd.coll.emb_apply`
+    scope. No-op (identity) for unsharded tables."""
+    sh = table_sharding(program, pname)
+    if sh is None:
+        return vals if len(vals) != 1 else vals[0]
+    from ..parallel._collectives import coll_scope
+    out = []
+    with coll_scope("emb_apply"):
+        for v in vals:
+            try:
+                out.append(jax.lax.with_sharding_constraint(v, sh))
+            except (TypeError, ValueError):
+                out.append(v)
+    return tuple(out) if len(out) != 1 else out[0]
+
+
+# --- scatter-apply kernels -------------------------------------------------
+#
+# Shared shape: merge duplicate rows (segment_sum, static num_segments),
+# gather the touched rows of param/accumulators (out-of-range padded
+# slots clamp harmlessly), run the op family's *_dense math on the
+# gathered [K, D] slab, scatter back with mode="drop" (padded slots
+# carry row == height, out of range, so they vanish). Bitwise equal to
+# the dense update on touched rows for sgd/momentum when ids are unique;
+# duplicate ids differ from the dense scatter-add only by summation
+# order inside the merge.
+
+def _merged(p, sr: SelectedRowsVal):
+    rows, gv = merge_selected_rows(sr)
+    return rows, gv.astype(p.dtype)
+
+
+def _rows(x, rows):
+    return jnp.take(x, rows, axis=0, mode="clip")
+
+
+def sgd_apply(p, lr, sr: SelectedRowsVal):
+    """reference sgd_op.h SelectedRows branch, merge-first."""
+    from . import optimizer_ops
+    rows, gv = _merged(p, sr)
+    count_apply_rows("sgd", rows.shape[0])
+    po = optimizer_ops.sgd_dense(_rows(p, rows), gv, lr)
+    return p.at[rows].set(po.astype(p.dtype), mode="drop")
+
+
+def momentum_apply(p, v, lr, mu, use_nesterov, sr: SelectedRowsVal):
+    """Lazy momentum: velocity decays + param moves only on the
+    gradient's rows (matching sparse adam's lazy semantics)."""
+    from . import optimizer_ops
+    rows, gv = _merged(p, sr)
+    count_apply_rows("momentum", rows.shape[0])
+    po, vo = optimizer_ops.momentum_dense(
+        _rows(p, rows), gv, _rows(v, rows), lr, mu, use_nesterov)
+    return (p.at[rows].set(po.astype(p.dtype), mode="drop"),
+            v.at[rows].set(vo.astype(v.dtype), mode="drop"))
+
+
+def adam_apply(p, m1, m2, lr, b1, b2, eps, b1p, b2p, sr: SelectedRowsVal):
+    """Lazy adam (reference adam_op.h SparseAdamFunctor): moments/param
+    update only the gradient's rows; untouched rows keep stale moments.
+    O(K*D) instead of the O(V*D) densified update."""
+    from . import optimizer_ops
+    rows, gv = _merged(p, sr)
+    count_apply_rows("adam", rows.shape[0])
+    po, m1o, m2o = optimizer_ops.adam_dense(
+        _rows(p, rows), gv, _rows(m1, rows), _rows(m2, rows),
+        lr, b1, b2, eps, b1p, b2p)
+    return (p.at[rows].set(po.astype(p.dtype), mode="drop"),
+            m1.at[rows].set(m1o.astype(m1.dtype), mode="drop"),
+            m2.at[rows].set(m2o.astype(m2.dtype), mode="drop"))

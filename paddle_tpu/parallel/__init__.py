@@ -10,6 +10,9 @@ from . import tensor_parallel
 from .tensor_parallel import (shard_parameter, shard_fc_params,
                               shard_all_params_zero)
 from . import ring_attention
+from . import embedding
+from .embedding import (SpecLayout, shard_table, shard_embeddings,
+                        per_shard_table_bytes)
 from . import pipeline
 from .pipeline import gpipe
 from . import program_pipeline
@@ -56,8 +59,12 @@ def per_shard_param_bytes(program, scope=None):
         if not b:
             continue
         factor = 1
-        for ax in specs.get(p.name) or ():
-            if ax:
+        for ent in specs.get(p.name) or ():
+            # dim entries may be one axis ("fsdp") or an axis tuple
+            # (("fsdp", "tp") — embedding.SpecLayout row sharding)
+            axes = (tuple(ent) if isinstance(ent, (tuple, list))
+                    else (ent,) if ent else ())
+            for ax in axes:
                 factor *= int(axis_sizes.get(ax, 1))
         if factor > 1:
             per_dev = -(-b // factor)   # ceil: XLA pads uneven shards

@@ -193,6 +193,32 @@ def _grad_pairs(program) -> List[Tuple[str, str]]:
     return out
 
 
+_CONSUMER_CACHE: Dict[Tuple[int, int], Dict[str, str]] = {}
+
+
+def _grad_consumer_map(program) -> Dict[str, str]:
+    """{grad var name -> consuming optimizer op type}, cached per
+    (program, version): lets _flush label a SelectedRows gradient as
+    handled-by-scatter-apply vs genuinely unsupported."""
+    key = (id(program), getattr(program, "_version", 0))
+    hit = _CONSUMER_CACHE.get(key)
+    if hit is not None:
+        return hit
+    out: Dict[str, str] = {}
+    try:
+        ops = program.global_block().ops
+    except AttributeError:   # synthetic test programs
+        ops = ()
+    for op_ in ops:
+        ins = op_.desc.inputs
+        if "Grad" in ins and "Param" in ins and ins["Grad"]:
+            out[ins["Grad"][0]] = op_.type
+    _CONSUMER_CACHE[key] = out
+    while len(_CONSUMER_CACHE) > 64:
+        _CONSUMER_CACHE.pop(next(iter(_CONSUMER_CACHE)))
+    return out
+
+
 def _build(program) -> Optional[OverlapPlan]:
     import numpy as np
 
@@ -211,6 +237,17 @@ def _build(program) -> Optional[OverlapPlan]:
         anchor = last.get(gname)
         if anchor is None:
             continue  # grad never produced in this block (pruned)
+        if pname in (getattr(program, "_sharded_tables", None) or {}):
+            # row-sharded embedding table: the grad is SelectedRows by
+            # construction and the scatter-apply optimizer consumes it —
+            # handled by the sparse path, not an overlap miss
+            count_fallback(program, "sharded_table_sparse_path")
+            continue
+        if gname in (getattr(program, "_sparse_grad_names", None) or ()):
+            # is_sparse embedding grad (append_backward records these):
+            # stays SelectedRows end-to-end on purpose
+            count_fallback(program, "sparse_grad_handled")
+            continue
         if specs.get(pname):
             # tensor/ZeRO-sharded params: their grads are not replicated
             # partial sums — GSPMD's per-param resharding stays
@@ -294,8 +331,17 @@ def _flush(ctx, bucket: Bucket, env: Dict[str, Any]):
                 continue
             if isinstance(v, SelectedRowsVal):
                 # sparse grads keep the per-param SelectedRows path —
-                # densifying an embedding grad to bucket it is O(vocab)
-                count_fallback(program, "sparse_grad")
+                # densifying an embedding grad to bucket it is O(vocab).
+                # Distinguish "the scatter-apply optimizer handles this"
+                # (expected, not a miss) from a consumer that will
+                # densify anyway (a genuine overlap+sparse gap).
+                from ..ops import sparse_ops
+                opt_t = _grad_consumer_map(program).get(gname)
+                if opt_t in sparse_ops.SPARSE_APPLY_OPS \
+                        and sparse_ops.sparse_apply_enabled():
+                    count_fallback(program, "sparse_grad_handled")
+                else:
+                    count_fallback(program, "sparse_grad_unsupported")
                 continue
             try:
                 env[gname] = jax.lax.with_sharding_constraint(v, repl)

@@ -58,6 +58,27 @@ def test_jit_lint_reads_real_source():
     assert "_jit_compile" in src and src.count("jax.jit(") == 1
 
 
+def test_sparse_table_consistent():
+    """ISSUE 10 satellite: SPARSE_APPLY_OPS, the optimizer lowerings'
+    SelectedRows branches, executor._SPARSE_AWARE_OPS and the
+    fused_sparse_ bucket types must all agree — a gap in any of them
+    silently densifies the gradient instead of failing."""
+    problems = _load_checker().check_sparse_table()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_sparse_lint_catches_missing_entry(monkeypatch):
+    """Sanity: dropping an op from SPARSE_APPLY_OPS trips the converse
+    check (its _apply kernel still exists but would never run)."""
+    from paddle_tpu.ops import sparse_ops
+
+    checker = _load_checker()
+    monkeypatch.setattr(sparse_ops, "SPARSE_APPLY_OPS",
+                        ("sgd", "momentum"))
+    problems = checker.check_sparse_table()
+    assert any("adam" in m for _, m in problems), problems
+
+
 def test_cli_passes():
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     r = subprocess.run(

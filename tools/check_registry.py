@@ -19,6 +19,11 @@ kind silently misattributes), each busbw factor table entry must have a
 kind and vice versa, and each kind's canonical HLO spelling must land in
 the roofline waterfall's "collective" bucket — otherwise a new kind falls
 into "(unattributed)" or the wrong waterfall bar without any test failing.
+
+The sparse-table lint (ISSUE 10 satellite) pins sparse_ops.SPARSE_APPLY_OPS
+against the optimizer lowerings, the executor's sparse-aware boundary set
+and the fused-bucket types: a missing entry doesn't raise either — the
+gradient silently densifies and the update goes O(table rows).
 """
 
 import sys
@@ -122,6 +127,64 @@ def check_jit_sites():
     return problems
 
 
+def check_sparse_table():
+    """[(where, message), ...] — pin sparse_ops.SPARSE_APPLY_OPS (ISSUE 10)
+    against the three layers that must agree on it: every listed optimizer
+    needs a `<op>_apply` scatter kernel in ops/sparse_ops.py AND a
+    SelectedRows branch in ops/optimizer_ops.py that calls it, every
+    listed op (plus its fused_sparse_ bucket variant) must sit in
+    executor._SPARSE_AWARE_OPS so the sparse boundary doesn't densify its
+    Grad input first, and the fused variants must be registered +
+    FUSED_OP_TYPES-listed. The converse holds too: a `*_apply` kernel for
+    an op missing from SPARSE_APPLY_OPS silently never runs — `sum` (grad
+    accumulation) is the one sparse-aware op with no apply kernel."""
+    import inspect
+
+    from paddle_tpu import executor
+    from paddle_tpu.ops import fusion, optimizer_ops, registry, sparse_ops
+
+    problems = []
+    registered = set(registry.registered_ops())
+    opt_src = inspect.getsource(optimizer_ops)
+    for t in sparse_ops.SPARSE_APPLY_OPS:
+        if t not in registered:
+            problems.append(("sparse_ops.SPARSE_APPLY_OPS",
+                             f"'{t}' is not registered in ops/registry.py"))
+        if not callable(getattr(sparse_ops, t + "_apply", None)):
+            problems.append(("sparse_ops.SPARSE_APPLY_OPS",
+                             f"'{t}' has no {t}_apply scatter kernel in "
+                             f"ops/sparse_ops.py"))
+        if f"sparse_ops.{t}_apply" not in opt_src:
+            problems.append((
+                "optimizer_ops", f"'{t}' lowering never calls "
+                f"sparse_ops.{t}_apply — its SelectedRows branch is gone "
+                f"and the boundary would densify silently"))
+        for name in (t, "fused_sparse_" + t):
+            if name not in executor._SPARSE_AWARE_OPS:
+                problems.append((
+                    "executor._SPARSE_AWARE_OPS",
+                    f"'{name}' missing — the sparse boundary densifies "
+                    f"its Grad input before the scatter kernel sees it"))
+        if "fused_sparse_" + t not in fusion.FUSED_OP_TYPES:
+            problems.append((
+                "fusion.FUSED_OP_TYPES",
+                f"'fused_sparse_{t}' missing — its bucket op would fail "
+                f"the registration lint"))
+    for name in dir(sparse_ops):
+        if name.endswith("_apply") and callable(getattr(sparse_ops, name)):
+            op = name[:-len("_apply")]
+            if op not in sparse_ops.SPARSE_APPLY_OPS:
+                problems.append((
+                    "sparse_ops.SPARSE_APPLY_OPS",
+                    f"kernel '{name}' exists but '{op}' is not listed — "
+                    f"the scatter path silently never runs"))
+    if "sum" not in executor._SPARSE_AWARE_OPS:
+        problems.append((
+            "executor._SPARSE_AWARE_OPS",
+            "'sum' missing — SelectedRows grad accumulation densifies"))
+    return problems
+
+
 def main():
     problems = check_tables()
     for tname, name in problems:
@@ -132,7 +195,10 @@ def main():
     jit = check_jit_sites()
     for where, msg in jit:
         print(f"{where}: {msg}")
-    problems = problems + coll + jit
+    sparse = check_sparse_table()
+    for where, msg in sparse:
+        print(f"{where}: {msg}")
+    problems = problems + coll + jit + sparse
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")

@@ -22,6 +22,13 @@ state shardings riding the scan carry — so the sweep captures the
 dispatch-overhead trend next to the scaling trend; every per-mesh JSON
 line carries a `steps_per_call` column.
 
+SCALE_MODEL=embedding swaps the image model for the criteo-style sparse
+embedding net (ISSUE 10): a [SCALE_EMB_ROWS x SCALE_EMB_DIM] table looked
+up by SCALE_EMB_SLOTS features per example, fsdp-row-sharded over the
+mesh, Adam scatter-apply end-to-end. Its per-mesh lines add
+rows_touched_per_sec and table_bytes_per_shard — the memory column falls
+~1/n while throughput holds.
+
 On a CPU host it exercises the identical GSPMD path over virtual devices
 — mechanism check only; the shared core makes the timings say nothing
 about ICI. Use SCALE_PLATFORM=cpu (the env var JAX_PLATFORMS alone does
@@ -128,25 +135,64 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
     from paddle_tpu.framework import unique_name
 
     batch = per_device_batch * n_devices
+    emb_cfg = None
     with unique_name.guard():
         main, startup = fluid.Program(), fluid.Program()
-        with fluid.program_guard(main, startup):
-            img = fluid.layers.data(name="img", shape=[3, 32, 32],
-                                    dtype="float32")
-            label = fluid.layers.data(name="label", shape=[1],
-                                      dtype="int64")
-            avg_cost, _, _ = models.build_image_classifier(
-                getattr(models, model_name), img, label, class_dim=10)
-            fluid.optimizer.Momentum(learning_rate=0.001,
-                                     momentum=0.9).minimize(
-                avg_cost, startup_program=startup)
-        if n_devices > 1:
-            main._mesh = Mesh(np.array(jax.devices()[:n_devices]), ("dp",))
+        rng = np.random.default_rng(0)
+        if model_name == "embedding":
+            # sparse-embedding scaling family (ISSUE 10): the table and its
+            # adam moments shard ROW-wise over an fsdp mesh, so the sweep's
+            # memory column shows per-shard table HBM falling ~1/n while
+            # rows_touched_per_sec holds — the recommender-model motivation
+            # for fsdp-partitioned tables
+            emb_cfg = {
+                "rows": int(os.environ.get("SCALE_EMB_ROWS", "100000")),
+                "dim": int(os.environ.get("SCALE_EMB_DIM", "64")),
+                "slots": int(os.environ.get("SCALE_EMB_SLOTS", "26"))}
+            with fluid.program_guard(main, startup):
+                ids = fluid.layers.data(name="img",
+                                        shape=[emb_cfg["slots"]],
+                                        dtype="int64")
+                label = fluid.layers.data(name="label", shape=[1],
+                                          dtype="int64")
+                emb = fluid.layers.embedding(
+                    ids, size=[emb_cfg["rows"], emb_cfg["dim"]],
+                    is_sparse=True,
+                    param_attr=fluid.ParamAttr(name="emb_table"))
+                flat = fluid.layers.reshape(
+                    emb, shape=[-1, emb_cfg["slots"] * emb_cfg["dim"]])
+                h = fluid.layers.fc(input=flat, size=256, act="relu")
+                logits = fluid.layers.fc(input=h, size=2)
+                avg_cost = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(logits, label))
+                fluid.optimizer.Adam(learning_rate=1e-3).minimize(
+                    avg_cost, startup_program=startup)
+            if n_devices > 1:
+                from paddle_tpu.parallel import embedding as emb_mod
+                main._mesh = Mesh(np.array(jax.devices()[:n_devices]),
+                                  ("fsdp",))
+                emb_mod.shard_table(main, "emb_table", "fsdp")
+            x = rng.integers(0, emb_cfg["rows"],
+                             (batch, emb_cfg["slots"])).astype(np.int64)
+            y = rng.integers(0, 2, (batch, 1)).astype(np.int64)
+        else:
+            with fluid.program_guard(main, startup):
+                img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                        dtype="float32")
+                label = fluid.layers.data(name="label", shape=[1],
+                                          dtype="int64")
+                avg_cost, _, _ = models.build_image_classifier(
+                    getattr(models, model_name), img, label, class_dim=10)
+                fluid.optimizer.Momentum(learning_rate=0.001,
+                                         momentum=0.9).minimize(
+                    avg_cost, startup_program=startup)
+            if n_devices > 1:
+                main._mesh = Mesh(np.array(jax.devices()[:n_devices]),
+                                  ("dp",))
+            x = rng.standard_normal((batch, 3, 32, 32), dtype=np.float32)
+            y = rng.integers(0, 10, (batch, 1)).astype(np.int64)
 
         exe = fluid.Executor(fluid.TPUPlace(0))
-        rng = np.random.default_rng(0)
-        x = rng.standard_normal((batch, 3, 32, 32), dtype=np.float32)
-        y = rng.integers(0, 10, (batch, 1)).astype(np.int64)
         k = steps_per_call
         # per-step feed is always built: the k=1 path runs on it (also the
         # probe path for `auto`), and static_memory_analysis below reports
@@ -202,8 +248,34 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
             except Exception:
                 pass
             perf = _perf_fields(run_one)
+            if emb_cfg is not None:
+                perf.update(_embedding_fields(
+                    main, emb_cfg, batch * steps / dt))
     assert np.isfinite(final)
     return batch * steps / dt, peak_hbm, perf, k
+
+
+def _embedding_fields(main, emb_cfg, examples_per_sec):
+    """Extra per-mesh columns for the embedding family: sparse-path
+    throughput in rows touched (ids presented to the table) per second,
+    the table geometry, whether scatter-apply was live, and per-shard
+    table bytes — the 1/n memory trend the fsdp sharding buys."""
+    from paddle_tpu.ops import sparse_ops
+    out = {"rows_touched_per_sec": round(
+               examples_per_sec * emb_cfg["slots"], 1),
+           "table_rows": emb_cfg["rows"],
+           "sparse_apply": sparse_ops.sparse_apply_enabled()}
+    try:
+        from paddle_tpu.parallel import embedding as emb_mod
+        t = emb_mod.per_shard_table_bytes(main)["tables"].get("emb_table")
+        if t is None:     # 1-device run: table never sharded
+            t = {"bytes": emb_cfg["rows"] * emb_cfg["dim"] * 4,
+                 "per_shard_bytes": emb_cfg["rows"] * emb_cfg["dim"] * 4}
+        out["table_bytes"] = t["bytes"]
+        out["table_bytes_per_shard"] = t["per_shard_bytes"]
+    except Exception:  # noqa: BLE001 - bytes columns are best-effort
+        pass
+    return out
 
 
 def _perf_fields(run_one):
