@@ -286,6 +286,39 @@ _VLOG_LEVEL = int(os.environ.get("PADDLE_TPU_VLOG", "0") or 0)
 _TELEMETRY_FETCH = os.environ.get("PADDLE_TPU_TELEMETRY_FETCH", "1") == "1"
 
 
+_WARNED_CPU_SCAN_CONV = False
+
+
+def _maybe_warn_cpu_scan_conv(device, program, steps):
+    """Warn ONCE when a multi-step run_steps window is about to lower a
+    conv backward inside lax.scan on the CPU backend: XLA:CPU runs
+    grad-conv under scan ~60x slower than the same ops dispatched per
+    step (the PR 5 windowed-dispatch caveat, previously documented only
+    in CHANGES.md). Correctness is unaffected — tests stay on the
+    windowed path — but a CPU training loop that cares about wall time
+    should use per-step run() or a TPU backend."""
+    global _WARNED_CPU_SCAN_CONV
+    if _WARNED_CPU_SCAN_CONV or steps <= 1:
+        return
+    plat = getattr(device, "platform", None)
+    if plat is None:
+        plat = jax.default_backend()
+    if plat != "cpu":
+        return
+    types = {o.type for o in program.global_block().ops}
+    if not (types & {"conv2d_grad", "depthwise_conv2d_grad", "conv3d_grad",
+                     "conv2d_transpose_grad"}):
+        return
+    _WARNED_CPU_SCAN_CONV = True
+    import warnings
+    warnings.warn(
+        "run_steps is lowering a conv backward inside a lax.scan window "
+        "on the XLA:CPU backend — known ~60x slower than per-step "
+        "dispatch (see CHANGES.md, windowed dispatch caveat). Use "
+        "exe.run() per step or steps=1 for CPU training wall time; TPU "
+        "backends are unaffected.", RuntimeWarning, stacklevel=3)
+
+
 def _vlog_level() -> int:
     """Live verbosity: the flags registry re-reads PADDLE_TPU_VLOG on every
     call, so flags.set("vlog", n) changes vlog() output at runtime (the
@@ -644,6 +677,7 @@ class Executor:
                     reason = "lod_state"
                     break
         if reason is None:
+            _maybe_warn_cpu_scan_conv(self.device, program, steps)
             try:
                 return self._run_steps_window(
                     program, stacked, steps, fetch_list, scope, return_numpy,

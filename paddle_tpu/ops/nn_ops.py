@@ -17,7 +17,8 @@ import numpy as np
 
 from ..framework.desc import OpDesc
 from ..framework.framework import grad_var_name
-from .registry import NO_GRAD, op, register
+from .registry import (NO_GRAD, generic_grad_lower, infer_grad_shapes, op,
+                       register)
 from .common import (SelectedRowsVal, in_var, mxu_cast, out_var,
                      same_as_input, set_out, to_np_dtype)
 
@@ -278,8 +279,13 @@ def _conv2d(ctx, op_, ins):
     Under the trace-time layout convention (ops/layout.py) the NHWC
     result is kept and tagged so the whole conv/bn/pool stack runs NHWC
     with one transpose at each end; with the convention off, the
-    user-visible NCHW layout is restored per conv."""
+    user-visible NCHW layout is restored per conv.
+
+    Eligible shapes (pallas_conv.ineligible is the shared gate) route to
+    the hand-tiled Pallas MXU kernel; the rest keep lax.conv with a
+    reason-labelled pallas_fallback_total counter."""
     from . import layout as layout_mod
+    from . import pallas_conv
     x = jnp.asarray(ins["Input"][0])
     w = jnp.asarray(ins["Filter"][0])
     s = _pair(op_.attr("strides", [1, 1]))
@@ -290,11 +296,17 @@ def _conv2d(ctx, op_, ins):
     (x, w), restore = mxu_cast(ctx, x, w)
     if not nhwc_in:
         x = jnp.transpose(x, (0, 2, 3, 1))
-    out = jax.lax.conv_general_dilated(
-        x, jnp.transpose(w, (2, 3, 1, 0)),
-        window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
-        rhs_dilation=d, feature_group_count=groups,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    reason = pallas_conv.ineligible(x, w, s, p, d, groups)
+    if reason is None:
+        pallas_conv.count_hit(op_.type)
+        out = pallas_conv.conv2d(x, w, s, p, d)
+    else:
+        pallas_conv.count_fallback(op_.type, reason)
+        out = jax.lax.conv_general_dilated(
+            x, jnp.transpose(w, (2, 3, 1, 0)),
+            window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
+            rhs_dilation=d, feature_group_count=groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
     if restore is not None:
         out = out.astype(restore)
     if ctx.layout_opt:
@@ -307,6 +319,83 @@ def _conv2d(ctx, op_, ins):
 @op("depthwise_conv2d", infer_shape=_conv2d_infer)
 def _depthwise_conv2d(ctx, op_, ins):
     return _conv2d(ctx, op_, ins)
+
+
+@op("conv2d_grad", infer_shape=infer_grad_shapes, grad=NO_GRAD)
+def _conv2d_grad(ctx, op_, ins):
+    """Explicit conv backward: eligible shapes take the Pallas grad-input
+    and grad-filter kernels; the rest defer to generic_grad_lower (vjp of
+    the forward lowering), which re-traces the forward under the SAME
+    eligibility predicate — pallas_call is not differentiable, so the
+    gate must agree in both directions (check_pallas_table pins this).
+
+    Layout contract (matches the generic path's tag bookkeeping): the
+    Output@GRAD cotangent arrives NHWC-tagged when the layout convention
+    is on (layout.align_cotangents' prepass) and NCHW otherwise;
+    Input@GRAD must be produced in Input's current layout because
+    tag_outputs re-tags it from the forward var; Filter@GRAD is always
+    canonical OIHW."""
+    from . import layout as layout_mod
+    from . import pallas_conv
+    douts = ins.get("Output@GRAD")
+    if not douts or douts[0] is None:
+        # Zero cotangent (output unused by the loss): emit explicit
+        # zeros. Deferring to generic_grad_lower would jax.vjp the
+        # forward lowering, and for Pallas-eligible shapes that re-trace
+        # hits pl.pallas_call — which has no transpose rule — and crashes
+        # at trace time. zeros_like keeps each grad in its forward var's
+        # current layout and dtype, satisfying the contract above.
+        outs = {}
+        for slot, names in op_.desc.outputs.items():
+            base = slot[: -len("@GRAD")]
+            srcs = ins.get(base, [])
+            outs[slot] = [
+                jnp.zeros_like(jnp.asarray(srcs[i]))
+                if i < len(srcs) and srcs[i] is not None else None
+                for i in range(len(names))]
+        return outs
+    x = jnp.asarray(ins["Input"][0])
+    w = jnp.asarray(ins["Filter"][0])
+    s = _pair(op_.attr("strides", [1, 1]))
+    p = _pair(op_.attr("paddings", [0, 0]))
+    d = _pair(op_.attr("dilations", [1, 1]))
+    groups = op_.attr("groups", 1) or 1
+    x_nhwc_in = ctx.layout_of(op_.desc.inputs["Input"][0]) == layout_mod.NHWC
+    (xc, wc), _ = mxu_cast(ctx, x, w)
+    x_nhwc = xc if x_nhwc_in else jnp.transpose(xc, (0, 2, 3, 1))
+    reason = pallas_conv.ineligible(x_nhwc, wc, s, p, d, groups)
+    if reason is not None:
+        pallas_conv.count_fallback(op_.type, reason)
+        # The forward lowering already counted itself when the forward
+        # graph was traced; mute its counters while the vjp re-traces it,
+        # or every grad fallback double-books the op=conv2d series.
+        with pallas_conv.suppress_counters():
+            return generic_grad_lower(ctx, op_, ins)
+    pallas_conv.count_hit(op_.type)
+    dout = jnp.asarray(ins["Output@GRAD"][0])
+    gname = op_.desc.inputs["Output@GRAD"][0]
+    if ctx.layout_of(gname) != layout_mod.NHWC:
+        dout = jnp.transpose(dout, (0, 2, 3, 1))
+    dout = dout.astype(jnp.bfloat16)
+    outs = {}
+    if "Input@GRAD" in op_.desc.outputs:
+        dx = pallas_conv.conv2d_grad_input(
+            dout, wc, (x_nhwc.shape[1], x_nhwc.shape[2]), s, p, d,
+            out_dtype=x.dtype)
+        if not x_nhwc_in:
+            dx = jnp.transpose(dx, (0, 3, 1, 2))
+        outs["Input@GRAD"] = [dx]
+    if "Filter@GRAD" in op_.desc.outputs:
+        dw = pallas_conv.conv2d_grad_filter(
+            x_nhwc, dout, (wc.shape[2], wc.shape[3]), s, p, d,
+            out_dtype=w.dtype)
+        outs["Filter@GRAD"] = [dw]
+    return outs
+
+
+@op("depthwise_conv2d_grad", infer_shape=infer_grad_shapes, grad=NO_GRAD)
+def _depthwise_conv2d_grad(ctx, op_, ins):
+    return _conv2d_grad(ctx, op_, ins)
 
 
 def _conv3d_infer(op_, block):

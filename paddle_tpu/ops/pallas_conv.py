@@ -1,0 +1,472 @@
+"""Tiled MXU Pallas kernels for conv2d forward / grad-input / grad-filter
+(the kernel phase of the MFU campaign: the scheduling levers are landed
+and the plateau is per kernel; /opt/skills/guides/pallas_guide.md
+patterns, ops/pallas_attention.py and the fusion bn+act kernel as the
+in-repo templates).
+
+Tiling: NHWC operands, bf16 on the MXU datapath with f32 VMEM
+accumulation (preferred_element_type), channels in 128-lane tiles. The
+grid walks one output row per step with an H block of size 1 — at block
+size 1 the BlockSpec index map addresses *rows*, so strided/dilated
+input-row selection (`oh*stride + kh*dilation`) happens in the index map
+and no halo exchange or revisit is needed. Inside the kernel the kw taps
+unroll as a Python loop of strided row slices feeding [W-ish, Ci] x
+[Ci, Co] MXU dots into an f32 accumulator that carries across the
+sequential (innermost) reduction dim of the grid:
+
+  forward      grid (N, OH, Co/128, KH*Ci/128), acc [OW, 128]
+  grad-filter  grid (KH, Ci/128, Co/128, N*OH), acc [KW, 128, 128]
+  grad-input   = the forward kernel on the stride-dilated cotangent with
+                 the spatially flipped filter and transposed-conv padding
+                 (lo = (K-1)*d - p, hi = H - Hd + p), so one kernel body
+                 serves both directions.
+
+`conv2d_stats` is the forward kernel with the Co tile as the *outermost*
+grid dim and per-channel sum/sum-of-squares carried in VMEM scratch: the
+conv->bn->act training window (ops/fusion.py) gets batch statistics for
+free while the output row is still in VMEM, then `bn_apply` normalizes
+(+activation) in one more sweep — the window never re-reads the conv
+output from HBM to compute statistics.
+
+Eligibility is one shared predicate (`ineligible`) for forward AND
+backward: the generated grad path vjp's the forward lowering
+(registry.generic_grad_lower) and pallas_call is not differentiable, so
+the forward may only take the Pallas route when the grad lowering will
+too. Unsupported combinations fall back to lax.conv with a
+reason-labelled `pallas_fallback_total{op,reason}` counter (mirroring
+fusion_fallback_total), never an error. On CPU (the test mesh) the
+kernels run under the Pallas interpreter — same code path, no Mosaic
+compile — so parity gates run under JAX_PLATFORMS=cpu.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .pallas_attention import _compiler_params, _dot, _interpret, _scratch
+
+__all__ = [
+    "FALLBACK_REASONS", "KERNELS", "PALLAS_CONV", "bn_apply", "conv2d",
+    "conv2d_grad_filter", "conv2d_grad_input", "conv2d_stats",
+    "count_fallback", "count_hit", "ineligible", "suppress_counters",
+    "supports",
+]
+
+PALLAS_CONV = os.environ.get("PADDLE_TPU_PALLAS_CONV", "1") == "1"
+
+_LANE = 128
+
+# Every reason `ineligible` can return (pinned by check_pallas_table —
+# a reason string produced but not listed here would ship an unlabelled
+# fallback counter).
+FALLBACK_REASONS = frozenset(
+    {"disabled", "rank", "groups", "dtype", "channels", "attrs",
+     "geometry"})
+
+# VMEM width budget: each grid step keeps a [Wp, 128] bf16 input row, an
+# [OW, 128] f32 accumulator and an [OW, 128] output row resident (double
+# buffered by the pipeline), and grad-input re-pads the cotangent to
+# W + KWe - 1 with OW' = W. 2048 lanes bounds that resident set around
+# 3 MB — comfortably inside the ~16 MB/core VMEM of current TPUs — so
+# wider shapes fall back to lax.conv instead of failing Mosaic
+# compilation at run time.
+_MAX_W = 2048
+
+
+def ineligible(x, w, strides, paddings, dilations, groups=1):
+    """None when the Pallas kernels apply, else the fallback reason.
+
+    `x` is the NHWC operand *post mxu_cast* (AMP O1/O2 convs are bf16 by
+    here; a plain f32 conv reads "dtype"), `w` the OIHW filter. The
+    predicate is shared verbatim by forward and grad routing — see the
+    module docstring for why they must agree — so it also encodes the
+    grad-input geometry: transposed-conv padding stays non-negative iff
+    p <= (K-1)*d per spatial dim.
+    """
+    if not PALLAS_CONV:
+        return "disabled"
+    if getattr(x, "ndim", 0) != 4 or getattr(w, "ndim", 0) != 4:
+        return "rank"
+    if (groups or 1) != 1:
+        return "groups"   # depthwise/grouped convs keep the lax path
+    if getattr(x, "dtype", None) != jnp.bfloat16 or \
+            getattr(w, "dtype", None) != jnp.bfloat16:
+        return "dtype"
+    ci = x.shape[3]
+    co, ci_w, kh, kw = w.shape
+    if ci % _LANE or co % _LANE or ci_w != ci:
+        return "channels"
+    if len(strides) != 2 or len(paddings) != 2 or len(dilations) != 2:
+        # e.g. Paddle's legal 4-element [top, bottom, left, right]
+        # paddings — attrs the symmetric tiling doesn't model
+        return "attrs"
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    keh, kew = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    oh = (x.shape[1] + 2 * ph - keh) // sh + 1
+    ow = (x.shape[2] + 2 * pw - kew) // sw + 1
+    if oh < 1 or ow < 1 or ph > keh - 1 or pw > kew - 1:
+        return "geometry"
+    if max(x.shape[2] + 2 * pw, x.shape[2] + kew - 1, ow) > _MAX_W:
+        # padded width (forward/grad-filter), the grad-input re-pad, or
+        # the accumulator row would overflow the VMEM row budget
+        return "geometry"
+    return None
+
+
+def supports(x, w, strides, paddings, dilations, groups=1) -> bool:
+    """Static eligibility, pallas_attention.supports-style."""
+    return ineligible(x, w, strides, paddings, dilations, groups) is None
+
+
+_SUPPRESS_COUNTERS = False
+
+
+@contextlib.contextmanager
+def suppress_counters():
+    """Silence count_hit/count_fallback on this thread of lowering:
+    generic_grad_lower's vjp re-traces the forward lowering, which would
+    book a second pallas_fallback_total/pallas_kernel_total sample for a
+    forward op that already counted itself when the forward graph was
+    traced — inflating the coverage-trending series."""
+    global _SUPPRESS_COUNTERS
+    prev = _SUPPRESS_COUNTERS
+    _SUPPRESS_COUNTERS = True
+    try:
+        yield
+    finally:
+        _SUPPRESS_COUNTERS = prev
+
+
+def count_fallback(op: str, reason: str):
+    if _SUPPRESS_COUNTERS:
+        return
+    from .. import telemetry
+    telemetry.counter(
+        "pallas_fallback_total",
+        "conv lowerings that fell back from the Pallas kernel suite to "
+        "the lax.conv path, by op and gating reason",
+        labels=("op", "reason")).labels(op=op, reason=reason).inc()
+
+
+def count_hit(op: str):
+    if _SUPPRESS_COUNTERS:
+        return
+    from .. import telemetry
+    telemetry.counter(
+        "pallas_kernel_total",
+        "conv lowerings served by the Pallas kernel suite, by op",
+        labels=("op",)).labels(op=op).inc()
+
+
+# --- kernel bodies ------------------------------------------------------
+
+def _taps(x_row, kw_n, dw, sw, ow):
+    """The kw tap slices of one padded input row: [OW, 128] each, strided
+    by the conv stride. Slice bounds always fit the padded width — the
+    widest tap ends at (KW-1)*dw + (OW-1)*sw + 1 = Wp by the output-dim
+    equation."""
+    for kw in range(kw_n):
+        yield lax.slice(x_row, (kw * dw, 0),
+                        (kw * dw + (ow - 1) * sw + 1, x_row.shape[1]),
+                        (sw, 1))
+
+
+def _fwd_kernel(x_ref, w_ref, o_ref, acc, *, kw_n, dw, sw, ow, n_s):
+    """Grid (N, OH, Co/128, KH*Ci/128): one output row [OW, 128] per
+    (n, oh, co), reduction taps streamed innermost."""
+    import jax.experimental.pallas as pl
+    ss = pl.program_id(3)
+
+    @pl.when(ss == 0)
+    def _zero():
+        acc[...] = jnp.zeros_like(acc)
+
+    x_row = x_ref[0, 0]            # [Wp, 128] one padded input row
+    wt = w_ref[0]                  # [KW, 128, 128] one kh tap
+    for kw, xs in enumerate(_taps(x_row, kw_n, dw, sw, ow)):
+        acc[...] += _dot(xs, wt[kw], ((1,), (0,)))
+
+    @pl.when(ss == n_s - 1)
+    def _finish():
+        o_ref[0, 0] = acc[...].astype(o_ref.dtype)
+
+
+def _fwd_stats_kernel(x_ref, w_ref, o_ref, sum_ref, sq_ref, acc, ssum, ssq,
+                      *, kw_n, dw, sw, ow, n_s, n_n, n_oh):
+    """Forward + per-channel sum/sumsq of the rounded output. Grid
+    (Co/128, N, OH, KH*Ci/128) — Co outermost so the [1, 128] statistics
+    scratch carries across every output row of its channel tile. The
+    statistics are of the *bf16-rounded* y, matching what the unfused bn
+    would read back from HBM."""
+    import jax.experimental.pallas as pl
+    nn = pl.program_id(1)
+    hh = pl.program_id(2)
+    ss = pl.program_id(3)
+
+    @pl.when(jnp.logical_and(nn == 0, jnp.logical_and(hh == 0, ss == 0)))
+    def _zero_stats():
+        ssum[...] = jnp.zeros_like(ssum)
+        ssq[...] = jnp.zeros_like(ssq)
+
+    @pl.when(ss == 0)
+    def _zero():
+        acc[...] = jnp.zeros_like(acc)
+
+    x_row = x_ref[0, 0]
+    wt = w_ref[0]
+    for kw, xs in enumerate(_taps(x_row, kw_n, dw, sw, ow)):
+        acc[...] += _dot(xs, wt[kw], ((1,), (0,)))
+
+    @pl.when(ss == n_s - 1)
+    def _finish():
+        y = acc[...].astype(o_ref.dtype)
+        o_ref[0, 0] = y
+        yf = y.astype(jnp.float32)
+        ssum[...] += jnp.sum(yf, axis=0, keepdims=True)
+        ssq[...] += jnp.sum(yf * yf, axis=0, keepdims=True)
+
+    @pl.when(jnp.logical_and(nn == n_n - 1,
+                             jnp.logical_and(hh == n_oh - 1, ss == n_s - 1)))
+    def _write_stats():
+        sum_ref[...] = ssum[...]
+        sq_ref[...] = ssq[...]
+
+
+def _wgrad_kernel(x_ref, do_ref, o_ref, acc, *, kw_n, dw, sw, ow, m_n):
+    """Grid (KH, Ci/128, Co/128, N*OH): each step contracts one padded
+    input row against one cotangent row over OW, accumulating all KW taps
+    of a [128, 128] dW tile in one visit."""
+    import jax.experimental.pallas as pl
+    mm = pl.program_id(3)
+
+    @pl.when(mm == 0)
+    def _zero():
+        acc[...] = jnp.zeros_like(acc)
+
+    x_row = x_ref[0, 0]            # [Wp, 128ci]
+    do_row = do_ref[0, 0]          # [OW, 128co]
+    for kw, xs in enumerate(_taps(x_row, kw_n, dw, sw, ow)):
+        acc[kw] += _dot(xs, do_row, ((0,), (0,)))
+
+    @pl.when(mm == m_n - 1)
+    def _finish():
+        o_ref[0] = acc[...].astype(o_ref.dtype)
+
+
+def _bn_apply_kernel(x_ref, scale_ref, bias_ref, mean_ref, var_ref, *refs,
+                     eps, act):
+    """Normalize + activation given precomputed statistics — phase 1 of
+    the fusion bn+act kernel with the statistics pass replaced by the
+    conv2d_stats epilogue."""
+    if act is None:
+        (ybn_ref,) = refs
+        yact_ref = None
+    else:
+        ybn_ref, yact_ref = refs
+    inv = jax.lax.rsqrt(var_ref[...] + eps)
+    xb = x_ref[...].astype(jnp.float32)
+    y = (xb - mean_ref[...]) * (inv * scale_ref[...]) + bias_ref[...]
+    y = y.astype(ybn_ref.dtype)
+    ybn_ref[...] = y
+    if yact_ref is not None:
+        yact_ref[...] = act(y)
+
+
+# --- pallas_call wrappers -----------------------------------------------
+
+def _conv_call(x, w_hwio, strides, dilations, pads, out_dtype=None,
+               stats=False):
+    """Shared conv driver. `x` NHWC (unpadded), `w_hwio` [KH, KW, Ci, Co],
+    `pads` explicit ((lo_h, hi_h), (lo_w, hi_w)) so the grad-input call
+    can pass the asymmetric transposed-conv padding."""
+    import jax.experimental.pallas as pl
+    n, _, _, ci = x.shape
+    kh, kw_n, _, co = w_hwio.shape
+    sh, sw = strides
+    dh, dw = dilations
+    xp = jnp.pad(x, ((0, 0), tuple(pads[0]), tuple(pads[1]), (0, 0)))
+    hp, wp = xp.shape[1], xp.shape[2]
+    oh = (hp - ((kh - 1) * dh + 1)) // sh + 1
+    ow = (wp - ((kw_n - 1) * dw + 1)) // sw + 1
+    n_ci = ci // _LANE
+    n_s = kh * n_ci
+    out_dtype = out_dtype or x.dtype
+
+    if not stats:
+        grid = (n, oh, co // _LANE, n_s)
+        x_spec = pl.BlockSpec(
+            (1, 1, wp, _LANE),
+            lambda nn, hh, cc, ss: (nn, hh * sh + (ss // n_ci) * dh, 0,
+                                    ss % n_ci))
+        w_spec = pl.BlockSpec(
+            (1, kw_n, _LANE, _LANE),
+            lambda nn, hh, cc, ss: (ss // n_ci, 0, ss % n_ci, cc))
+        o_spec = pl.BlockSpec((1, 1, ow, _LANE),
+                              lambda nn, hh, cc, ss: (nn, hh, 0, cc))
+        kernel = functools.partial(_fwd_kernel, kw_n=kw_n, dw=dw, sw=sw,
+                                   ow=ow, n_s=n_s)
+        return pl.pallas_call(
+            kernel, grid=grid, in_specs=[x_spec, w_spec], out_specs=o_spec,
+            out_shape=jax.ShapeDtypeStruct((n, oh, ow, co), out_dtype),
+            scratch_shapes=[_scratch((ow, _LANE))],
+            interpret=_interpret(),
+            compiler_params=_compiler_params(
+                ("parallel", "parallel", "parallel", "arbitrary")),
+        )(xp, w_hwio)
+
+    grid = (co // _LANE, n, oh, n_s)
+    x_spec = pl.BlockSpec(
+        (1, 1, wp, _LANE),
+        lambda cc, nn, hh, ss: (nn, hh * sh + (ss // n_ci) * dh, 0,
+                                ss % n_ci))
+    w_spec = pl.BlockSpec(
+        (1, kw_n, _LANE, _LANE),
+        lambda cc, nn, hh, ss: (ss // n_ci, 0, ss % n_ci, cc))
+    o_spec = pl.BlockSpec((1, 1, ow, _LANE),
+                          lambda cc, nn, hh, ss: (nn, hh, 0, cc))
+    vec_spec = pl.BlockSpec((1, _LANE), lambda cc, nn, hh, ss: (0, cc))
+    kernel = functools.partial(_fwd_stats_kernel, kw_n=kw_n, dw=dw, sw=sw,
+                               ow=ow, n_s=n_s, n_n=n, n_oh=oh)
+    return pl.pallas_call(
+        kernel, grid=grid, in_specs=[x_spec, w_spec],
+        out_specs=[o_spec, vec_spec, vec_spec],
+        out_shape=[jax.ShapeDtypeStruct((n, oh, ow, co), out_dtype),
+                   jax.ShapeDtypeStruct((1, co), jnp.float32),
+                   jax.ShapeDtypeStruct((1, co), jnp.float32)],
+        scratch_shapes=[_scratch((ow, _LANE)), _scratch((1, _LANE)),
+                        _scratch((1, _LANE))],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(
+            ("parallel", "arbitrary", "arbitrary", "arbitrary")),
+    )(xp, w_hwio)
+
+
+def conv2d(x, w, strides, paddings, dilations, out_dtype=None):
+    """x [N, H, W, Ci] bf16, w [Co, Ci, KH, KW] bf16 -> y [N, OH, OW, Co].
+    Caller must have passed the `ineligible` gate."""
+    ph, pw = paddings
+    return _conv_call(x, jnp.transpose(w, (2, 3, 1, 0)), strides, dilations,
+                      ((ph, ph), (pw, pw)), out_dtype=out_dtype)
+
+
+def conv2d_stats(x, w, strides, paddings, dilations, out_dtype=None):
+    """conv2d plus per-channel (sum, sum-of-squares) of the rounded
+    output: (y, csum [Co], csq [Co]) — the fused conv->bn->act window's
+    statistics come for free from VMEM."""
+    ph, pw = paddings
+    y, csum, csq = _conv_call(
+        x, jnp.transpose(w, (2, 3, 1, 0)), strides, dilations,
+        ((ph, ph), (pw, pw)), out_dtype=out_dtype, stats=True)
+    return y, csum.reshape(-1), csq.reshape(-1)
+
+
+def conv2d_grad_input(dout, w, x_hw, strides, paddings, dilations,
+                      out_dtype=None):
+    """dL/dx as a transposed conv through the forward kernel: dilate the
+    cotangent by the stride, flip the filter spatially and swap its
+    channel axes, pad lo=(K-1)*d-p / hi=H-Hd+p (both non-negative by the
+    shared gate), then run the stride-1 forward."""
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    co, ci, kh, kw = w.shape
+    h, wdim = x_hw
+    n, oh, ow, _ = dout.shape
+    hd, wd = (oh - 1) * sh + 1, (ow - 1) * sw + 1
+    if sh > 1 or sw > 1:
+        dd = jnp.zeros((n, hd, wd, co), dout.dtype)
+        dd = dd.at[:, ::sh, ::sw, :].set(dout)
+    else:
+        dd = dout
+    keh, kew = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    w_t = jnp.transpose(jnp.flip(w, (2, 3)), (2, 3, 0, 1))  # [KH,KW,Co,Ci]
+    return _conv_call(
+        dd, w_t, (1, 1), dilations,
+        ((keh - 1 - ph, h - hd + ph), (kew - 1 - pw, wdim - wd + pw)),
+        out_dtype=out_dtype)
+
+
+def conv2d_grad_filter(x, dout, kernel_hw, strides, paddings, dilations,
+                       out_dtype=None):
+    """dL/dw [Co, Ci, KH, KW]: per-(kh, ci, co) tiles accumulated over the
+    N*OH row pairs in f32 scratch, rounded once at the end."""
+    import jax.experimental.pallas as pl
+    n, _, _, ci = x.shape
+    _, oh, ow, co = dout.shape
+    kh, kw_n = kernel_hw
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    xp = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    wp = xp.shape[2]
+    m_n = n * oh
+    grid = (kh, ci // _LANE, co // _LANE, m_n)
+    x_spec = pl.BlockSpec(
+        (1, 1, wp, _LANE),
+        lambda kk, ii, cc, mm: (mm // oh, (mm % oh) * sh + kk * dh, 0, ii))
+    do_spec = pl.BlockSpec(
+        (1, 1, ow, _LANE), lambda kk, ii, cc, mm: (mm // oh, mm % oh, 0, cc))
+    o_spec = pl.BlockSpec((1, kw_n, _LANE, _LANE),
+                          lambda kk, ii, cc, mm: (kk, 0, ii, cc))
+    kernel = functools.partial(_wgrad_kernel, kw_n=kw_n, dw=dw, sw=sw,
+                               ow=ow, m_n=m_n)
+    g_hwio = pl.pallas_call(
+        kernel, grid=grid, in_specs=[x_spec, do_spec], out_specs=o_spec,
+        out_shape=jax.ShapeDtypeStruct((kh, kw_n, ci, co),
+                                       out_dtype or x.dtype),
+        scratch_shapes=[_scratch((kw_n, _LANE, _LANE))],
+        interpret=_interpret(),
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "parallel", "arbitrary")),
+    )(xp, dout)
+    return jnp.transpose(g_hwio, (3, 2, 0, 1))
+
+
+def bn_apply(x2, scale, bias, mean, var, eps, act_fn):
+    """x2 [M, C] bf16 (C % 128 == 0, M % 8 == 0); scale/bias/mean/var f32
+    [C]. Returns (ybn, yact) with yact None when act_fn is — the fusion
+    bn+act kernel's normalize phase, statistics supplied by
+    conv2d_stats."""
+    import jax.experimental.pallas as pl
+    m_total, c = x2.shape
+    bc = _LANE
+    bm = next(b for b in (512, 256, 128, 64, 32, 16, 8) if m_total % b == 0)
+    grid = (c // bc, m_total // bm)
+    x_spec = pl.BlockSpec((bm, bc), lambda cc, mm: (mm, cc))
+    vec_spec = pl.BlockSpec((1, bc), lambda cc, mm: (0, cc))
+    out_specs = [x_spec] + ([x_spec] if act_fn is not None else [])
+    out_shape = [jax.ShapeDtypeStruct((m_total, c), x2.dtype)]
+    if act_fn is not None:
+        out_shape.append(jax.ShapeDtypeStruct((m_total, c), x2.dtype))
+    kernel = functools.partial(_bn_apply_kernel, eps=eps, act=act_fn)
+    outs = pl.pallas_call(
+        kernel, grid=grid,
+        in_specs=[x_spec, vec_spec, vec_spec, vec_spec, vec_spec],
+        out_specs=out_specs, out_shape=out_shape,
+        interpret=_interpret(),
+        compiler_params=_compiler_params(("parallel", "parallel")),
+    )(x2, scale.reshape(1, c), bias.reshape(1, c), mean.reshape(1, c),
+      var.reshape(1, c))
+    if act_fn is not None:
+        return outs[0], outs[1]
+    return outs[0], None
+
+
+# Dispatch table: which registered op types route through this suite, and
+# with which kernels. check_pallas_table pins it against ops/registry.py
+# and fusion.CONV_OPS — an op listed here but not dispatched (or vice
+# versa) silently loses the kernel, so the lint fails instead.
+KERNELS = {
+    "conv2d": (conv2d, conv2d_stats),
+    "depthwise_conv2d": (conv2d,),        # groups gate: always falls back
+    "conv2d_grad": (conv2d_grad_input, conv2d_grad_filter),
+    "depthwise_conv2d_grad": (conv2d_grad_input, conv2d_grad_filter),
+}

@@ -1,0 +1,472 @@
+"""Pallas conv kernel suite (ops/pallas_conv.py, ISSUE 11): parity
+gates for every kernel, the eligibility gate's reason labels, the
+PADDLE_TPU_PALLAS_CONV=0 escape hatch, and the CPU scan+grad-conv
+warning.
+
+Each kernel ships a parity gate against the lax.conv reference it
+replaces: forward/grad-input/grad-filter vs lax.conv_general_dilated /
+jax.vjp on the same bf16-rounded operands (tolerance covers only f32
+accumulation-order drift, observed relative error <=3e-4), conv2d_stats
+vs conv2d bitwise, bn_apply vs the normalize formula bitwise. On CPU the
+kernels run under Pallas interpret mode, so this whole file is tier-1
+under JAX_PLATFORMS=cpu and re-runs compiled on a real TPU unchanged.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as em
+from paddle_tpu import telemetry
+from paddle_tpu.framework import unique_name
+from paddle_tpu.ops import pallas_conv
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _with_pallas(on, fn, *args, **kw):
+    """Run fn under PALLAS_CONV=on. Callers build a FRESH program inside
+    fn — the jit and plan caches key on program identity."""
+    old = pallas_conv.PALLAS_CONV
+    pallas_conv.PALLAS_CONV = on
+    try:
+        return fn(*args, **kw)
+    finally:
+        pallas_conv.PALLAS_CONV = old
+
+
+def _series(name, label=None):
+    s = telemetry.read_series(name)
+    if label is None:
+        return sum(s.values())
+    return sum(v for k, v in s.items() if label in k)
+
+
+# --- direct-kernel parity ----------------------------------------------
+
+# (H, W, KH, KW, strides, paddings, dilations) — C fixed at one 128 lane
+# tile. Covers stride, asymmetric spatial dims, 1x1, dilation+padding,
+# and mixed per-dim stride/padding.
+CASES = [
+    (6, 6, 3, 3, (1, 1), (1, 1), (1, 1)),
+    (9, 9, 3, 3, (2, 2), (1, 1), (1, 1)),
+    (8, 8, 1, 1, (1, 1), (0, 0), (1, 1)),
+    (10, 10, 3, 3, (1, 1), (2, 2), (2, 2)),
+    (7, 9, 2, 3, (2, 1), (1, 2), (1, 1)),
+]
+
+
+def _operands(h, w, kh, kw, n=2, c=128, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((n, h, w, c)), jnp.bfloat16)
+    wt = jnp.asarray(rng.standard_normal((c, c, kh, kw)) * 0.1,
+                     jnp.bfloat16)
+    return x, wt
+
+
+def _ref_fwd(x, wt, s, p, d):
+    """f32 lax.conv on the same bf16-rounded operands: the kernels only
+    reassociate the f32 accumulation, so this is the exact target."""
+    return jax.lax.conv_general_dilated(
+        x.astype(jnp.float32), wt.astype(jnp.float32),
+        window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],
+        rhs_dilation=d, dimension_numbers=("NHWC", "OIHW", "NHWC"))
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_forward_parity(case):
+    h, w, kh, kw, s, p, d = case
+    x, wt = _operands(h, w, kh, kw)
+    assert pallas_conv.supports(x, wt, s, p, d)
+    y = pallas_conv.conv2d(x, wt, s, p, d, out_dtype=jnp.float32)
+    ref = _ref_fwd(x, wt, s, p, d)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_grad_parity(case):
+    h, w, kh, kw, s, p, d = case
+    x, wt = _operands(h, w, kh, kw, seed=1)
+    ref, vjp = jax.vjp(lambda a, b: _ref_fwd(a, b, s, p, d), x, wt)
+    ct = jnp.asarray(
+        np.random.default_rng(2).standard_normal(ref.shape), jnp.bfloat16)
+    dx_ref, dw_ref = vjp(ct.astype(jnp.float32))
+    dx = pallas_conv.conv2d_grad_input(ct, wt, (h, w), s, p, d,
+                                       out_dtype=jnp.float32)
+    dw = pallas_conv.conv2d_grad_filter(x, ct, (kh, kw), s, p, d,
+                                        out_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                               rtol=3e-2, atol=3e-2)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_stats_kernel_matches_plain_conv():
+    """conv2d_stats' output tile is the SAME accumulation as conv2d —
+    bitwise — and its channel sums match the rounded output."""
+    h, w, kh, kw, s, p, d = CASES[1]
+    x, wt = _operands(h, w, kh, kw, seed=3)
+    y = pallas_conv.conv2d(x, wt, s, p, d)
+    ys, csum, csq = pallas_conv.conv2d_stats(x, wt, s, p, d)
+    np.testing.assert_array_equal(np.asarray(ys, np.float32),
+                                  np.asarray(y, np.float32))
+    yf = np.asarray(ys, np.float32).reshape(-1, 128)
+    np.testing.assert_allclose(np.asarray(csum), yf.sum(0),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(csq), (yf * yf).sum(0),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_bn_apply_matches_formula():
+    rng = np.random.default_rng(4)
+    x2 = jnp.asarray(rng.standard_normal((16, 128)), jnp.bfloat16)
+    scale = jnp.asarray(rng.standard_normal(128), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal(128), jnp.float32)
+    mean = jnp.asarray(rng.standard_normal(128), jnp.float32)
+    var = jnp.asarray(rng.random(128) + 0.5, jnp.float32)
+    eps = 1e-5
+    ybn, yact = pallas_conv.bn_apply(x2, scale, bias, mean, var, eps,
+                                     jax.nn.relu)
+    ref = ((x2.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)
+           * scale + bias).astype(jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(ybn, np.float32),
+                                  np.asarray(ref, np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(yact, np.float32),
+        np.asarray(jax.nn.relu(ref), np.float32))
+
+
+# --- the eligibility gate ----------------------------------------------
+
+def test_ineligible_reasons():
+    x = jnp.zeros((2, 6, 6, 128), jnp.bfloat16)
+    w = jnp.zeros((128, 128, 3, 3), jnp.bfloat16)
+    args = ((1, 1), (1, 1), (1, 1))
+    assert pallas_conv.ineligible(x, w, *args) is None
+    assert pallas_conv.supports(x, w, *args)
+    assert _with_pallas(
+        False, pallas_conv.ineligible, x, w, *args) == "disabled"
+    assert pallas_conv.ineligible(x[0], w, *args) == "rank"
+    assert pallas_conv.ineligible(x, w, *args, groups=2) == "groups"
+    assert pallas_conv.ineligible(
+        x.astype(jnp.float32), w, *args) == "dtype"
+    assert pallas_conv.ineligible(
+        x[..., :120], w[:, :120], *args) == "channels"
+    # padding beyond (K-1)*d breaks the grad-input transposed-conv pads
+    assert pallas_conv.ineligible(
+        x, w, (1, 1), (5, 5), (1, 1)) == "geometry"
+    # output collapses to zero rows
+    assert pallas_conv.ineligible(
+        x, w, (1, 1), (0, 0), (4, 4)) == "geometry"
+    # Paddle's legal 4-element [top, bottom, left, right] paddings: the
+    # gate must label the fallback, not crash unpacking — these programs
+    # ran on the lax path before the suite existed
+    assert pallas_conv.ineligible(
+        x, w, (1, 1), [1, 1, 1, 1], (1, 1)) == "attrs"
+    # padded width beyond the VMEM row budget falls back instead of
+    # failing Mosaic compilation at run time
+    wide = jax.ShapeDtypeStruct((1, 6, 4096, 128), jnp.bfloat16)
+    assert pallas_conv.ineligible(wide, w, *args) == "geometry"
+    for reason in ("disabled", "rank", "groups", "dtype", "channels",
+                   "attrs", "geometry"):
+        assert reason in pallas_conv.FALLBACK_REASONS
+
+
+def test_zero_cotangent_returns_zeros_without_retrace():
+    """Output@GRAD absent (conv output unused by the loss): the grad
+    lowering must emit explicit zero grads in the forward vars' shapes
+    and dtypes — delegating to the generic vjp would re-trace the
+    Pallas-eligible forward into pl.pallas_call, which has no transpose
+    rule, and crash at trace time."""
+    from paddle_tpu.framework.desc import OpDesc
+    from paddle_tpu.framework.framework import Operator
+    from paddle_tpu.ops import registry
+
+    x, wt = _operands(6, 6, 3, 3)   # Pallas-eligible bf16 128-lane shape
+    op_ = Operator.__new__(Operator)
+    op_.block = None
+    op_.desc = OpDesc(
+        type="conv2d_grad",
+        inputs={"Input": ["x"], "Filter": ["w"], "Output": ["y"],
+                "Output@GRAD": ["y@GRAD"]},
+        outputs={"Input@GRAD": ["x@GRAD"], "Filter@GRAD": ["w@GRAD"]},
+        attrs={"strides": [1, 1], "paddings": [1, 1],
+               "dilations": [1, 1], "groups": 1})
+    outs = registry.get("conv2d_grad").lower(
+        None, op_, {"Input": [x], "Filter": [wt], "Output@GRAD": [None]})
+    dx, = outs["Input@GRAD"]
+    dw, = outs["Filter@GRAD"]
+    assert dx.shape == x.shape and dx.dtype == x.dtype
+    assert dw.shape == wt.shape and dw.dtype == wt.dtype
+    assert not np.asarray(dx, np.float32).any()
+    assert not np.asarray(dw, np.float32).any()
+    # a zero grad is not a kernel decision: neither counter moves
+    assert _series("pallas_kernel_total") == 0
+    assert _series("pallas_fallback_total") == 0
+
+
+def test_suppress_counters_context():
+    with pallas_conv.suppress_counters():
+        pallas_conv.count_hit("conv2d")
+        pallas_conv.count_fallback("conv2d", "dtype")
+    assert _series("pallas_kernel_total") == 0
+    assert _series("pallas_fallback_total") == 0
+    pallas_conv.count_fallback("conv2d", "dtype")
+    assert _series("pallas_fallback_total") == 1
+
+
+# --- through-program: routing, counters, escape hatch ------------------
+
+def _train_bf16_convnet(steps=3):
+    """AMP O2 conv(C=128)+bn(relu)+pool+fc+SGD: the bf16 NHWC shape the
+    Pallas suite targets — forward via the fused conv->bn->act window,
+    backward via the conv2d_grad dispatch."""
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[128, 6, 6],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(input=img, num_filters=128, filter_size=3,
+                                padding=1, bias_attr=False)
+        b = fluid.layers.batch_norm(input=c, act="relu")
+        gp = fluid.layers.pool2d(input=b, global_pooling=True,
+                                 pool_type="avg")
+        logits = fluid.layers.fc(input=gp, size=5)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(
+            loss, startup_program=startup)
+    fluid.amp.enable(main, level="O2")
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.default_rng(6)
+    losses = []
+    scope = em.Scope()
+    with em.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            xv = rng.standard_normal((4, 128, 6, 6)).astype(np.float32)
+            yv = rng.integers(0, 5, (4, 1)).astype(np.int64)
+            out, = exe.run(main, feed={"img": xv, "label": yv},
+                           fetch_list=[loss])
+            losses.append(float(np.ravel(out)[0]))
+    return losses
+
+
+def test_amp_o2_training_routes_through_pallas():
+    """Gate ON: the forward conv is consumed by the fused conv->bn->act
+    window (hits count as fused_conv_bn_act, not conv2d) and the
+    backward routes through conv2d_grad; losses match the gate-OFF lax
+    path within bf16 tolerance, and OFF counts per-op `disabled`
+    fallbacks with zero kernel hits."""
+    l_on = _with_pallas(True, _train_bf16_convnet)
+    assert _series("pallas_kernel_total", "op=fused_conv_bn_act") > 0
+    assert _series("pallas_kernel_total", "op=conv2d_grad") > 0
+    assert _series("pallas_fallback_total") == 0
+
+    telemetry.reset()
+    l_off = _with_pallas(False, _train_bf16_convnet)
+    assert _series("pallas_kernel_total") == 0
+    assert _series("pallas_fallback_total", "reason=disabled") > 0
+    np.testing.assert_allclose(l_on, l_off, rtol=0, atol=5e-3)
+
+
+def test_gate_off_is_deterministic_old_path():
+    """PADDLE_TPU_PALLAS_CONV=0 must restore the lax path bit-for-bit:
+    two OFF runs from identical seeds are bitwise equal, and every conv
+    family lowering reports reason=disabled (nothing else gates)."""
+    l0 = _with_pallas(False, _train_bf16_convnet)
+    series = telemetry.read_series("pallas_fallback_total")
+    assert series and all("reason=disabled" in k for k in series), series
+    telemetry.reset()
+    l1 = _with_pallas(False, _train_bf16_convnet)
+    assert l0 == l1
+
+
+def test_f32_conv_counts_dtype_fallback():
+    """A plain f32 program never reaches the bf16-only kernels: the
+    fallback counter must say WHY (reason=dtype), and the program still
+    runs to completion on the lax path — unsupported is never an
+    error."""
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[8, 6, 6],
+                                dtype="float32")
+        c = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                                padding=1, bias_attr=False)
+        loss = fluid.layers.mean(c)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = em.Scope()
+    with em.scope_guard(scope):
+        exe.run(startup)
+        out, = exe.run(main, feed={
+            "img": np.ones((2, 8, 6, 6), np.float32)}, fetch_list=[loss])
+    assert np.isfinite(np.asarray(out)).all()
+    assert _series("pallas_fallback_total", "reason=dtype") > 0
+    assert _series("pallas_kernel_total") == 0
+
+
+def test_grad_fallback_counts_forward_once():
+    """conv2d_grad's fallback re-traces the forward lowering inside
+    generic_grad_lower; that re-trace must not book a second
+    pallas_fallback_total{op=conv2d} sample on top of the one the
+    forward trace already counted — the coverage-trending series would
+    read 2x."""
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[8, 6, 6],
+                                dtype="float32")
+        c = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                                padding=1, bias_attr=False)
+        loss = fluid.layers.mean(c)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = em.Scope()
+    with em.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"img": np.ones((2, 8, 6, 6), np.float32)},
+                fetch_list=[loss])
+    series = telemetry.read_series("pallas_fallback_total")
+    fwd = _series("pallas_fallback_total", "op=conv2d,")
+    bwd = _series("pallas_fallback_total", "op=conv2d_grad,")
+    assert fwd == bwd > 0, series
+
+
+def test_depthwise_conv2d_grad_falls_back_by_groups():
+    """groups != 1 is outside the kernel envelope: the explicit
+    depthwise_conv2d_grad lowering must count reason=groups (or dtype
+    for an f32 trace — whichever gate fires first stays labelled) and
+    delegate to the generic vjp, matching central differences."""
+    from op_test import OpTest
+
+    rng = np.random.default_rng(12)
+    x = rng.random((1, 2, 4, 4)).astype("float32")
+    wt = rng.random((2, 1, 3, 3)).astype("float32")
+    t = OpTest()
+    t.op_type = "depthwise_conv2d"
+    t.inputs = {"Input": x, "Filter": wt}
+    t.attrs = {"strides": [1, 1], "paddings": [0, 0], "groups": 2}
+    t.outputs = {"Output": np.zeros((1, 2, 2, 2), "float32")}
+    t.check_grad(["Input", "Filter"], "Output",
+                 max_relative_error=0.02)
+    assert _series("pallas_fallback_total",
+                   "op=depthwise_conv2d_grad") > 0
+    assert _series("pallas_kernel_total") == 0
+
+
+# --- run_steps: windowed parity + the CPU scan+grad-conv warning -------
+
+def _scan_convnet():
+    unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[4, 6, 6],
+                                dtype="float32")
+        c = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        loss = fluid.layers.mean(c)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    return main, startup, loss
+
+
+def _feeds(k=2):
+    rng = np.random.default_rng(8)
+    return [{"img": rng.standard_normal((2, 4, 6, 6)).astype(np.float32)}
+            for _ in range(k)]
+
+
+def test_fused_window_parity_under_run_steps(monkeypatch):
+    """run_steps (lax.scan window) over the Pallas-routed bf16 net
+    matches per-step dispatch: the fused conv->bn->act + grad kernels
+    trace identically inside the scan body. Tolerance only for the
+    scan's f32 reduction-order drift."""
+    monkeypatch.setattr(em, "_WARNED_CPU_SCAN_CONV", True)  # mute here
+
+    def run(windowed):
+        unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[128, 6, 6],
+                                    dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            c = fluid.layers.conv2d(input=img, num_filters=128,
+                                    filter_size=3, padding=1,
+                                    bias_attr=False)
+            b = fluid.layers.batch_norm(input=c, act="relu")
+            gp = fluid.layers.pool2d(input=b, global_pooling=True,
+                                     pool_type="avg")
+            logits = fluid.layers.fc(input=gp, size=5)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(
+                loss, startup_program=startup)
+        fluid.amp.enable(main, level="O2")
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.default_rng(6)
+        feeds = [{"img": rng.standard_normal((4, 128, 6, 6)).astype(
+                      np.float32),
+                  "label": rng.integers(0, 5, (4, 1)).astype(np.int64)}
+                 for _ in range(2)]
+        scope = em.Scope()
+        with em.scope_guard(scope):
+            exe.run(startup)
+            if windowed:
+                out, = exe.run_steps(main, feed_window=feeds,
+                                     fetch_list=[loss],
+                                     fetch_mode="stack")
+                return [float(v) for v in np.ravel(out)]
+            return [float(np.ravel(exe.run(main, feed=f,
+                                           fetch_list=[loss])[0])[0])
+                    for f in feeds]
+
+    seq = run(False)
+    win = run(True)
+    np.testing.assert_allclose(seq, win, rtol=0, atol=5e-3)
+    assert _series("pallas_kernel_total", "op=fused_conv_bn_act") > 0
+
+
+def test_cpu_scan_grad_conv_warns_once(monkeypatch):
+    """The PR 5 caveat surfaced at the API: a multi-step run_steps window
+    with a conv backward on XLA:CPU warns (once per process) about the
+    ~60x scan slowdown; steps=1 and conv-less programs stay silent."""
+    monkeypatch.setattr(em, "_WARNED_CPU_SCAN_CONV", False)
+    main, startup, loss = _scan_convnet()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = em.Scope()
+    with em.scope_guard(scope):
+        exe.run(startup)
+        with pytest.warns(RuntimeWarning, match="conv backward"):
+            exe.run_steps(main, feed_window=_feeds(), fetch_list=[loss])
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            exe.run_steps(main, feed_window=_feeds(), fetch_list=[loss])
+        assert not [w for w in caught
+                    if issubclass(w.category, RuntimeWarning)
+                    and "conv backward" in str(w.message)], caught
+
+
+def test_cpu_scan_warning_skips_single_step(monkeypatch):
+    monkeypatch.setattr(em, "_WARNED_CPU_SCAN_CONV", False)
+    em._maybe_warn_cpu_scan_conv(None, _scan_convnet()[0], steps=1)
+    assert em._WARNED_CPU_SCAN_CONV is False

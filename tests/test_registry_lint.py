@@ -79,6 +79,36 @@ def test_sparse_lint_catches_missing_entry(monkeypatch):
     assert any("adam" in m for _, m in problems), problems
 
 
+def test_pallas_table_consistent():
+    """ISSUE 11 satellite: pallas_conv.KERNELS must agree with the op
+    registry, fusion.CONV_OPS and its own FALLBACK_REASONS — an orphan
+    kernel or a missing grad twin doesn't raise, the dispatch just
+    silently keeps the lax path (or worse, vjp's a pallas_call)."""
+    problems = _load_checker().check_pallas_table()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_pallas_lint_catches_missing_grad(monkeypatch):
+    """Sanity: dropping conv2d_grad from KERNELS trips the shared-gate
+    pairing check, and shrinking FALLBACK_REASONS trips the reason
+    audit."""
+    from paddle_tpu.ops import pallas_conv
+
+    checker = _load_checker()
+    orig = pallas_conv.KERNELS
+    kernels = dict(orig)
+    del kernels["conv2d_grad"]
+    monkeypatch.setattr(pallas_conv, "KERNELS", kernels)
+    problems = checker.check_pallas_table()
+    assert any("conv2d_grad" in m for _, m in problems), problems
+
+    monkeypatch.setattr(pallas_conv, "KERNELS", orig)
+    monkeypatch.setattr(pallas_conv, "FALLBACK_REASONS",
+                        pallas_conv.FALLBACK_REASONS - {"geometry"})
+    problems = checker.check_pallas_table()
+    assert any("geometry" in m for _, m in problems), problems
+
+
 def test_cli_passes():
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     r = subprocess.run(

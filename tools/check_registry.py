@@ -24,6 +24,11 @@ The sparse-table lint (ISSUE 10 satellite) pins sparse_ops.SPARSE_APPLY_OPS
 against the optimizer lowerings, the executor's sparse-aware boundary set
 and the fused-bucket types: a missing entry doesn't raise either — the
 gradient silently densifies and the update goes O(table rows).
+
+The Pallas-table lint (ISSUE 11 satellite) pins pallas_conv.KERNELS the
+same way: orphan kernels, conv window kinds without a dispatch entry,
+forward kernels missing their grad twin (the shared-gate/vjp contract),
+and fallback reasons the gate produces but FALLBACK_REASONS omits.
 """
 
 import sys
@@ -185,6 +190,77 @@ def check_sparse_table():
     return problems
 
 
+def check_pallas_table():
+    """[(where, message), ...] — pin pallas_conv.KERNELS (ISSUE 11)
+    against ops/registry.py and fusion.CONV_OPS. Three silent failure
+    modes: an orphan kernel (dispatched for an op that isn't registered,
+    or not in the fusion window table — the kernel never runs), a
+    registered conv op missing from KERNELS (it silently keeps the lax
+    path), and a fallback reason produced by the gate but absent from
+    FALLBACK_REASONS (an unlabelled counter series). The forward/grad
+    pairing is load-bearing, not stylistic: the generated grad path
+    vjp's the forward lowering and pallas_call is not differentiable, so
+    every dispatched forward MUST have a dispatched grad (and vice
+    versa) sharing the same gate."""
+    import inspect
+    import re
+
+    from paddle_tpu.ops import fusion, pallas_conv, registry
+
+    problems = []
+    registered = set(registry.registered_ops())
+    fwd_keys = {k for k in pallas_conv.KERNELS if not k.endswith("_grad")}
+    grad_keys = set(pallas_conv.KERNELS) - fwd_keys
+    for name in sorted(pallas_conv.KERNELS):
+        base = name[:-5] if name.endswith("_grad") else name
+        if base not in registered:
+            problems.append((
+                "pallas_conv.KERNELS",
+                f"'{name}' dispatched but '{base}' is not registered in "
+                f"ops/registry.py — orphan kernel"))
+        for fn in pallas_conv.KERNELS[name]:
+            if not callable(fn):
+                problems.append(("pallas_conv.KERNELS",
+                                 f"'{name}' lists a non-callable kernel"))
+    for name in sorted(fwd_keys):
+        if name not in fusion.CONV_OPS:
+            problems.append((
+                "pallas_conv.KERNELS",
+                f"forward '{name}' is not a fusion.CONV_OPS window kind — "
+                f"the conv_bn_act window would never see its kernel"))
+        if name + "_grad" not in grad_keys:
+            problems.append((
+                "pallas_conv.KERNELS",
+                f"'{name}' has no '{name}_grad' dispatch — the generic "
+                f"vjp would re-trace a non-differentiable pallas_call"))
+    for name in sorted(fusion.CONV_OPS):
+        if name not in fwd_keys:
+            problems.append((
+                "pallas_conv.KERNELS",
+                f"fusion.CONV_OPS '{name}' has no Pallas dispatch entry — "
+                f"it silently keeps the lax path"))
+    for name in sorted(grad_keys):
+        if name[:-5] not in fwd_keys:
+            problems.append((
+                "pallas_conv.KERNELS",
+                f"grad '{name}' has no forward dispatch — the gate "
+                f"predicate can't be shared"))
+    # every reason the gate can return must be declared, and vice versa
+    src = inspect.getsource(pallas_conv.ineligible)
+    produced = set(re.findall(r'return "([a-z_]+)"', src))
+    for reason in sorted(produced - pallas_conv.FALLBACK_REASONS):
+        problems.append((
+            "pallas_conv.FALLBACK_REASONS",
+            f"gate returns '{reason}' but it is not declared — an "
+            f"unlabelled pallas_fallback_total series"))
+    for reason in sorted(pallas_conv.FALLBACK_REASONS - produced):
+        problems.append((
+            "pallas_conv.FALLBACK_REASONS",
+            f"declared reason '{reason}' is never produced by the gate — "
+            f"dead counter label"))
+    return problems
+
+
 def main():
     problems = check_tables()
     for tname, name in problems:
@@ -198,7 +274,10 @@ def main():
     sparse = check_sparse_table()
     for where, msg in sparse:
         print(f"{where}: {msg}")
-    problems = problems + coll + jit + sparse
+    pallas = check_pallas_table()
+    for where, msg in pallas:
+        print(f"{where}: {msg}")
+    problems = problems + coll + jit + sparse + pallas
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")
