@@ -439,6 +439,30 @@ _CARRIED_ERRORS = []  # errors from a failed whole-family attempt (main())
 # after every emit (a failed family must not reuse the previous one's step).
 _PERF_STEP = [None]
 
+# program of the family currently being measured (same lifecycle as
+# _PERF_STEP): _emit runs the static verifier over it so every bench
+# line carries analyze_errors/analyze_warnings (ISSUE 12) — a perf
+# regression can be cross-read against new analyzer findings
+_ANALYZE_PROG = [None]
+
+
+def _analyze_fields():
+    """analyze_errors / analyze_warnings for the JSON line. The analysis
+    is abstract (no tracing, no device), so it adds milliseconds;
+    BENCH_ANALYZE=0 skips it and any failure degrades to no fields."""
+    prog = _ANALYZE_PROG[0]
+    if prog is None or os.environ.get("BENCH_ANALYZE", "1") != "1":
+        return {}
+    try:
+        from paddle_tpu.analysis import analyze_program
+
+        counts = analyze_program(prog).counts()
+        return {"analyze_errors": counts.get("error", 0),
+                "analyze_warnings": counts.get("warning", 0)}
+    except Exception as e:  # noqa: BLE001 - advisory, never kills the line
+        sys.stderr.write(f"static analysis skipped: {e}\n")
+        return {}
+
 
 def _perf_fields(probe=None):
     """`top_ops` / `bound` / `device_duty_cycle` for the JSON line (ISSUE 6:
@@ -543,7 +567,9 @@ def _emit(payload, errors=()):
         pass
     if payload.get("value") is not None:
         payload.update(_perf_fields(probe))
+    payload.update(_analyze_fields())
     _PERF_STEP[0] = None
+    _ANALYZE_PROG[0] = None
     print(json.dumps(payload))
     sys.stdout.flush()
 
@@ -623,6 +649,7 @@ def main_cnn(family, train=True):
         calls, warm = STEPS, WARMUP
 
     _PERF_STEP[0] = step
+    _ANALYZE_PROG[0] = main_prog
     errors = []
     dt, done = _timed_loop(step, warm, calls, errors)
     done *= k
@@ -712,6 +739,7 @@ def main_fc():
         calls, warm = STEPS, WARMUP
 
     _PERF_STEP[0] = step
+    _ANALYZE_PROG[0] = main_prog
     errors = []
     dt, done = _timed_loop(step, warm, calls, errors)
     done *= k
@@ -782,6 +810,7 @@ def main_lstm():
         return loss
 
     _PERF_STEP[0] = step
+    _ANALYZE_PROG[0] = main_prog
     errors = []
     dt, done = _timed_loop(step, warmup, steps, errors)
     ms_batch = dt / done * 1000
@@ -936,6 +965,7 @@ def main_transformer():
 
         if use_flash:
             _PERF_STEP[0] = step
+            _ANALYZE_PROG[0] = main_prog
         dt, done = _timed_loop(step, warmup, steps, errors)
         return dt / done  # seconds per step
 
@@ -1012,6 +1042,7 @@ def main_ring_attention():
         return out
 
     _PERF_STEP[0] = step
+    _ANALYZE_PROG[0] = main_prog
     errors = []
     dt, done = _timed_loop(step, warmup, steps, errors)
     s_step = dt / done
@@ -1085,6 +1116,7 @@ def main_embedding():
         return out
 
     _PERF_STEP[0] = step
+    _ANALYZE_PROG[0] = main_prog
     errors = []
     dt, done = _timed_loop(step, WARMUP, STEPS, errors)
     s_step = dt / done

@@ -47,6 +47,18 @@ def build(rows, dim, slots):
     return loss
 
 
+def build_programs(rows=100000, dim=64, slots=26):
+    """Programs-only surface for `python -m paddle_tpu analyze --example
+    criteo_dlrm` and the analyzer tests: same graph as main(), built into
+    fresh programs instead of the defaults."""
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        loss = build(rows, dim, slots)
+    return {"main": main_prog, "startup": startup,
+            "feeds": ["ids", "label"], "fetches": [loss.name],
+            "loss": loss}
+
+
 def synthetic_clicks(rng, batch, rows, slots):
     """Zipf-ish id draws — recommender tables are hit head-heavy, which is
     exactly when scatter-apply (O(rows touched)) beats a dense update."""

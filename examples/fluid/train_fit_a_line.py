@@ -19,16 +19,30 @@ import paddle_tpu.minibatch as minibatch
 import paddle_tpu.reader as reader
 
 
+def build_programs():
+    """The example's programs without running anything — the surface
+    `python -m paddle_tpu analyze --example fit_a_line` and the analyzer
+    tests drive."""
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(
+            loss, startup_program=startup)
+    return {"main": main_prog, "startup": startup, "feeds": ["x", "y"],
+            "fetches": [loss.name], "x": x, "y": y, "pred": pred,
+            "loss": loss}
+
+
 def main():
-    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
-    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
-    pred = fluid.layers.fc(input=x, size=1)
-    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
-    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    built = build_programs()
+    x, y, pred, loss = built["x"], built["y"], built["pred"], built["loss"]
 
     place = fluid.CPUPlace()
     exe = fluid.Executor(place)
-    exe.run(fluid.default_startup_program())
+    exe.run(built["startup"])
 
     batched = minibatch.batch(
         reader.shuffle(dataset.uci_housing.train(), buf_size=500),
@@ -37,12 +51,14 @@ def main():
 
     for pass_id in range(10):
         for data in batched():
-            avg, = exe.run(feed=feeder.feed(data), fetch_list=[loss])
+            avg, = exe.run(built["main"], feed=feeder.feed(data),
+                           fetch_list=[loss])
         print(f"pass {pass_id}: loss {float(np.ravel(avg)[0]):.4f}")
 
     with tempfile.TemporaryDirectory() as d:
         path = os.path.join(d, "fit_a_line.model")
-        fluid.io.save_inference_model(path, ["x"], [pred], exe)
+        fluid.io.save_inference_model(path, ["x"], [pred], exe,
+                                      main_program=built["main"])
         prog, feeds, fetches = fluid.io.load_inference_model(path, exe)
         sample = np.asarray(next(iter(batched()))[0][0],
                             np.float32).reshape(1, 13)

@@ -20,26 +20,36 @@ import paddle_tpu as fluid
 from paddle_tpu import models
 
 
-def main(use_ring=False):
-    seqlen, vocab = 512, 1024
-    tok = fluid.layers.data(name="tok", shape=[-1, seqlen], dtype="int64",
-                            append_batch_size=False)
-    lab = fluid.layers.data(name="lab", shape=[-1, seqlen], dtype="int64",
-                            append_batch_size=False)
-    loss = models.transformer_lm(
-        tok, lab, vocab_size=vocab, d_model=128, n_head=2, n_layer=2,
-        use_flash=not use_ring, sequence_parallel=use_ring)
-    fluid.optimizer.Adam(learning_rate=3e-4).minimize(loss)
-
-    main_prog = fluid.default_main_program()
+def build_programs(use_ring=False, seqlen=512, vocab=1024):
+    """Programs-only surface for `python -m paddle_tpu analyze --example
+    transformer_long_context` and the analyzer tests."""
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        tok = fluid.layers.data(name="tok", shape=[-1, seqlen],
+                                dtype="int64", append_batch_size=False)
+        lab = fluid.layers.data(name="lab", shape=[-1, seqlen],
+                                dtype="int64", append_batch_size=False)
+        loss = models.transformer_lm(
+            tok, lab, vocab_size=vocab, d_model=128, n_head=2, n_layer=2,
+            use_flash=not use_ring, sequence_parallel=use_ring)
+        fluid.optimizer.Adam(learning_rate=3e-4).minimize(
+            loss, startup_program=startup)
     if use_ring:
         import jax
         from paddle_tpu.parallel import mesh as mesh_mod
         main_prog._mesh = mesh_mod.make_mesh((len(jax.devices()),), ("sp",))
+    return {"main": main_prog, "startup": startup,
+            "feeds": ["tok", "lab"], "fetches": [loss.name], "loss": loss}
+
+
+def main(use_ring=False):
+    seqlen, vocab = 512, 1024
+    built = build_programs(use_ring=use_ring, seqlen=seqlen, vocab=vocab)
+    main_prog, loss = built["main"], built["loss"]
 
     exe = fluid.Executor(fluid.CPUPlace() if use_ring
                          else fluid.TPUPlace(0))
-    exe.run(fluid.default_startup_program())
+    exe.run(built["startup"])
 
     rng = np.random.default_rng(0)
     seq = rng.integers(0, vocab, (2, seqlen + 1))

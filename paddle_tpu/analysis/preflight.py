@@ -1,0 +1,256 @@
+"""Fast-path preflight advisors (the `preflight` pass).
+
+The runtime already measures every missed fast path after the fact
+(pallas_fallback_total, fusion_fallback_total, overlap_fallback_total,
+sparse_densify_fallback_total). This pass answers the question those
+counters can't: *before compile*, which ops will miss, and what
+one-line change fixes it. It dry-runs the real gates — never parallel
+re-implementations:
+
+  pallas   — pallas_conv.ineligible over abstract NHWC/OIHW avals built
+             from the desc shapes (bf16 when the program is
+             amp.decorate'd, since mxu_cast runs before the gate); when
+             the first answer is "dtype" we probe again in bf16 so an
+             AMP suggestion doesn't mask a channels problem behind it.
+  sharding — `_param_shardings` specs against the mesh axis sizes; GSPMD
+             requires every annotated dim divisible by the product of
+             its axes, and an axis name the mesh lacks silently means
+             "replicated", which is never what the annotation intended.
+             These two are the only *errors* this pass emits.
+  layout   — NHWC tag propagation walk (layout.AWARE_OPS/AGNOSTIC_OPS):
+             ops that force a transpose barrier, as advisory info.
+  plans    — fusion.plan / overlap.plan summaries, as advisory info.
+
+Missed fast paths are warnings (the program runs, slower); plan
+summaries and layout barriers are info.
+"""
+
+from __future__ import annotations
+
+_PROBE_BATCH = 8  # stand-in for symbolic -1 dims; gates never read it
+
+
+def _conv_hint(reason, ci, co):
+    return {
+        "disabled": "set PADDLE_TPU_PALLAS_CONV=1 to enable the kernels",
+        "rank": "the Pallas kernels only tile 4-D NCHW convs",
+        "groups": "grouped/depthwise convs keep the lax.conv path; use "
+                  "groups=1 for the MXU kernels",
+        "dtype": "run the program under amp.decorate (bf16 on the MXU "
+                 "datapath) — f32 convs never take the Pallas route",
+        "channels": f"pad channels to a multiple of 128 (Ci={ci}, "
+                    f"Co={co}): the MXU tiles lanes in 128s, so e.g. "
+                    f"Ci={-(-max(ci, 1) // 128) * 128} keeps the kernel "
+                    f"eligible",
+        "attrs": "use symmetric 2-element strides/paddings/dilations "
+                 "(the [top, bottom, left, right] padding form is not "
+                 "tiled)",
+        "geometry": "output must stay >= 1x1, padding < effective "
+                    "kernel, and padded width <= 2048 (the VMEM row "
+                    "budget)",
+    }.get(reason, reason)
+
+
+class _Aval:
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.ndim = len(shape)
+        self.dtype = dtype
+
+
+def _check_pallas_convs(pctx):
+    import jax.numpy as jnp
+
+    from ..ops import pallas_conv
+
+    amp = getattr(pctx.program, "_amp_dtype", None)
+    block = pctx.block
+    per_reason = {}  # reason -> detailed diagnostics emitted so far
+    rollup = {}      # reason -> suppressed count
+    for i, op in enumerate(pctx.ops):
+        if op.type != "conv2d":
+            continue
+        xn = (op.desc.input("Input") or [None])[0]
+        wn = (op.desc.input("Filter") or [None])[0]
+        if not (xn and wn and block.desc.has_var(xn)
+                and block.desc.has_var(wn)):
+            continue
+        xv, wv = block.desc.var(xn), block.desc.var(wn)
+        if (xv.shape is None or wv.shape is None
+                or len(xv.shape) != 4 or len(wv.shape) != 4):
+            continue  # the shapes pass already diagnosed rank problems
+        n, c, h, w = (_PROBE_BATCH if d == -1 else d for d in xv.shape)
+        # mxu_cast has run by the time the gate sees the operands
+        dt = jnp.bfloat16 if amp is not None else jnp.float32
+        x = _Aval((n, h, w, c), dt)
+        wt = _Aval(wv.shape, dt)
+        args = (list(op.attr("strides", [1, 1])),
+                list(op.attr("paddings", [0, 0])),
+                list(op.attr("dilations", [1, 1])),
+                int(op.attr("groups", 1) or 1))
+        reason = pallas_conv.ineligible(x, wt, *args)
+        if reason is None:
+            continue
+        ci, co = wv.shape[1], wv.shape[0]
+        hint = _conv_hint(reason, ci, co)
+        if reason == "dtype":
+            # would bf16 alone fix it, or is a deeper miss hiding behind
+            # the AMP suggestion?
+            deeper = pallas_conv.ineligible(
+                _Aval((n, h, w, c), jnp.bfloat16),
+                _Aval(wv.shape, jnp.bfloat16), *args)
+            if deeper is not None:
+                reason = f"dtype, then {deeper}"
+                hint = (f"{_conv_hint('dtype', ci, co)}; even then: "
+                        f"{_conv_hint(deeper, ci, co)}")
+        seen = per_reason.get(reason, 0)
+        if seen >= 4:
+            # a resnet emits one identical miss per conv — summarize the
+            # tail so the first few carry the detail
+            rollup[reason] = rollup.get(reason, 0) + 1
+            continue
+        per_reason[reason] = seen + 1
+        pctx.emit(
+            "warning", "pallas-conv-fallback",
+            f"will take the lax.conv fallback (reason: {reason}) "
+            f"instead of the tiled MXU Pallas kernels — forward and "
+            f"both grad convs all miss, since they share the gate",
+            op_index=i, var=xn, hint=hint)
+    for reason, n in sorted(rollup.items()):
+        pctx.emit("warning", "pallas-conv-fallback",
+                  f"{n} more conv2d op(s) fall back for the same reason "
+                  f"({reason}) — details suppressed after the first "
+                  f"{per_reason[reason]}")
+
+
+def _axis_factor(entry, axis_sizes):
+    """(divisor, missing axis names) for one PartitionSpec entry."""
+    if entry is None:
+        return 1, []
+    axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+    factor, missing = 1, []
+    for a in axes:
+        if a in axis_sizes:
+            factor *= int(axis_sizes[a])
+        else:
+            missing.append(a)
+    return factor, missing
+
+
+def _check_shardings(pctx):
+    specs = getattr(pctx.program, "_param_shardings", None) or {}
+    if not specs:
+        return
+    mesh = getattr(pctx.program, "_mesh", None)
+    if mesh is None:
+        pctx.emit("warning", "sharding-no-mesh",
+                  f"{len(specs)} parameter(s) carry sharding specs but "
+                  f"the program has no mesh — the annotations are dead",
+                  hint="tag the program with parallel.make_mesh before "
+                       "sharding parameters")
+        return
+    axis_sizes = dict(getattr(mesh, "shape", None) or {})
+    block = pctx.block
+    for pname in sorted(specs):
+        spec = specs[pname]
+        v = block.desc.vars.get(pname)
+        if v is None or v.shape is None:
+            pctx.emit("error", "sharding-unknown-param",
+                      f"sharding spec {spec} names '{pname}', which is "
+                      f"not a var of the global block", var=pname)
+            continue
+        shape = list(v.shape)
+        if len(spec) > len(shape):
+            pctx.emit("error", "sharding-rank",
+                      f"spec {spec} has {len(spec)} entries but "
+                      f"'{pname}' is rank {len(shape)} ({shape})",
+                      var=pname)
+            continue
+        for d, entry in enumerate(spec):
+            factor, missing = _axis_factor(entry, axis_sizes)
+            if missing:
+                pctx.emit(
+                    "error", "sharding-unknown-axis",
+                    f"spec {spec} for '{pname}' names mesh axis "
+                    f"'{missing[0]}' but the mesh only has "
+                    f"{sorted(axis_sizes) or 'no axes'}", var=pname,
+                    hint="GSPMD treats an unknown axis as replicated — "
+                         "fix the axis name or rebuild the mesh with it")
+                continue
+            if factor > 1 and shape[d] != -1 and shape[d] % factor:
+                pctx.emit(
+                    "error", "sharding-indivisible",
+                    f"'{pname}' dim {d} has size {shape[d]}, not "
+                    f"divisible by the {factor}-way split of spec entry "
+                    f"{entry!r}", var=pname,
+                    hint=f"pad the dim to "
+                         f"{-(-shape[d] // factor) * factor} or shard a "
+                         f"different dim")
+
+
+def _check_layout(pctx):
+    from ..ops import layout as layout_mod
+
+    tagged = set()  # var names carrying an NHWC-family tag
+    flagged = set()  # one advisory per op type
+    for i, op in enumerate(pctx.ops):
+        t = op.type
+        base = t[: -len("_grad")] if t.endswith("_grad") else t
+        ins = set(op.input_arg_names)
+        if base in layout_mod.AWARE_OPS:
+            tagged.update(op.output_arg_names)
+            continue
+        hit = sorted(ins & tagged)
+        if not hit:
+            continue
+        if base in layout_mod.AGNOSTIC_OPS:
+            tagged.update(op.output_arg_names)
+            continue
+        if base not in flagged:
+            flagged.add(base)
+            pctx.emit(
+                "info", "layout-barrier",
+                f"consumes NHWC-tagged '{hit[0]}' but is neither "
+                f"layout-aware nor layout-agnostic: under "
+                f"PADDLE_TPU_LAYOUT_OPT the value transposes back to "
+                f"NCHW here", op_index=i, var=hit[0])
+
+
+def _check_plans(pctx):
+    from ..ops import fusion
+    from ..parallel import overlap
+
+    program = pctx.program
+    if not fusion.FUSION_OPT:
+        pctx.emit("info", "fusion-plan",
+                  "fusion is disabled (PADDLE_TPU_FUSION=0): every op "
+                  "traces individually")
+    else:
+        groups = fusion.plan(program)
+        if groups:
+            kinds = {}
+            for g in groups.values():
+                kinds[g.kind] = kinds.get(g.kind, 0) + 1
+            desc = ", ".join(f"{k} x{n}" for k, n in sorted(kinds.items()))
+            pctx.emit("info", "fusion-plan",
+                      f"{len(groups)} fused window(s): {desc}")
+
+    mesh = getattr(program, "_mesh", None)
+    if mesh is None or "dp" not in getattr(mesh, "axis_names", ()):
+        return  # overlap only applies to dp-tagged programs
+    plan = overlap.plan(program)
+    if plan is None:
+        pctx.emit("info", "overlap-plan",
+                  "dp mesh but no overlap buckets (overlap disabled or "
+                  "no dense replicated parameter gradients)")
+    else:
+        pctx.emit("info", "overlap-plan",
+                  f"{len(plan.buckets)} eager all-reduce bucket(s) over "
+                  f"{sum(len(b.grads) for b in plan.buckets)} gradient(s)")
+
+
+def run(pctx):
+    _check_pallas_convs(pctx)
+    _check_shardings(pctx)
+    _check_layout(pctx)
+    _check_plans(pctx)

@@ -443,6 +443,76 @@ def _fmt_num(v: float) -> str:
     return f"{int(v)}" if float(v).is_integer() else f"{v:.6g}"
 
 
+def _analyze_programs(args):
+    """-> [(label, program, feeds, fetches)] from --config / --example /
+    --smoke (exactly one)."""
+    import paddle_tpu as fluid  # noqa: F401 - registers ops/layers
+
+    if args.config:
+        cfg = _load_config(args.config)
+        spec = cfg.build()
+        fetches = [spec["loss"].name] if spec.get("loss") is not None else []
+        for v in spec.get("fetch") or []:
+            n = v if isinstance(v, str) else v.name
+            if n not in fetches:
+                fetches.append(n)
+        return [(os.path.basename(args.config), spec["main_program"],
+                 list(spec.get("feed_order") or []), fetches)]
+    if args.example:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        name = args.example
+        path = name if os.path.exists(name) else os.path.join(
+            root, "examples", "fluid", f"train_{name}.py")
+        if not os.path.exists(path):
+            raise SystemExit(f"no such example: {args.example} "
+                             f"(looked for {path})")
+        spec_ = importlib.util.spec_from_file_location("paddle_tpu_example",
+                                                       path)
+        mod = importlib.util.module_from_spec(spec_)
+        spec_.loader.exec_module(mod)
+        if not hasattr(mod, "build_programs"):
+            raise SystemExit(f"example '{path}' has no build_programs()")
+        built = mod.build_programs()
+        return [(os.path.basename(path), built["main"],
+                 list(built.get("feeds") or []),
+                 list(built.get("fetches") or []))]
+    from . import memory
+    out = []
+    for name in (args.smoke or "fit_a_line").split(","):
+        b = memory.build_smoke(name.strip())
+        feeds = sorted(k for k, _ in b["feed_fn"](1).items()) \
+            if callable(b.get("feed_fn")) else []
+        out.append((b.get("label", name), b["main"], feeds,
+                    [b["loss"].name]))
+    return out
+
+
+def cmd_analyze(args):
+    """Static verification of a program: `python -m paddle_tpu analyze
+    --example fit_a_line` / `--config conf.py --strict` / `--smoke resnet
+    --json`. Exit 1 under --strict when error-severity diagnostics exist."""
+    import json
+
+    from .analysis import analyze_program
+
+    rc = 0
+    payloads = []
+    for label, program, feeds, fetches in _analyze_programs(args):
+        report = analyze_program(program, feeds=feeds or None,
+                                 fetches=fetches or None)
+        if args.json:
+            payloads.append({"program": label, **report.to_dict()})
+        else:
+            print(f"== {label} ==")
+            print(report.format(show_info=not args.no_info))
+        if args.strict and not report.ok:
+            rc = 1
+    if args.json:
+        print(json.dumps(payloads if len(payloads) > 1 else payloads[0],
+                         indent=2))
+    return rc
+
+
 def cmd_version(_args):
     import paddle_tpu
     import jax
@@ -720,6 +790,31 @@ def main(argv=None):
     p_fleet.add_argument("--no-probe", action="store_true",
                          help="skip the ICI/matmul/HBM probes")
     p_fleet.set_defaults(fn=cmd_fleet)
+
+    p_an = sub.add_parser(
+        "analyze", help="static program verification: shape/dtype/"
+                        "dataflow checks + fast-path preflight, no "
+                        "tracing or execution")
+    p_an.add_argument("--config", default=None,
+                      help="a train-style --config module; analyzes its "
+                           "build() main program")
+    p_an.add_argument("--example", default=None,
+                      help="a shipped example: fit_a_line, criteo_dlrm, "
+                           "transformer_long_context, or a path to any "
+                           "module with build_programs()")
+    p_an.add_argument("--smoke", nargs="?", const="fit_a_line",
+                      default=None,
+                      help="built-in smoke program(s), comma-separated "
+                           "(fit_a_line, resnet; default fit_a_line)")
+    p_an.add_argument("--json", action="store_true",
+                      help="machine-readable report (counts + "
+                           "diagnostics)")
+    p_an.add_argument("--strict", action="store_true",
+                      help="exit 1 when any error-severity diagnostic "
+                           "is reported")
+    p_an.add_argument("--no-info", action="store_true",
+                      help="hide info-severity advisories")
+    p_an.set_defaults(fn=cmd_analyze)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

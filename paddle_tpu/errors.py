@@ -8,7 +8,7 @@ reference paddle/utils/CustomStackTrace.h layer-stack dump)."""
 from __future__ import annotations
 
 __all__ = ["EnforceNotMet", "EOFException", "NonFiniteError", "NotFoundError",
-           "OOMError"]
+           "OOMError", "ProgramVerifyError"]
 
 
 class EnforceNotMet(RuntimeError):
@@ -103,6 +103,40 @@ class OOMError(MemoryError, RuntimeError):
             "analysis": self.analysis,
             "suggestions": self.suggestions,
             "device": self.device,
+        }
+
+
+class ProgramVerifyError(RuntimeError):
+    """The static analyzer (paddle_tpu.analysis) found error-severity
+    diagnostics in a program about to compile. Raised by the executor
+    under PADDLE_TPU_VERIFY=1 *before* tracing, so the message points at
+    the op's Python creation site instead of a JAX traceback — the
+    compile-time InferShape story of the reference, restored.
+
+    `diagnostics` holds the analysis.Diagnostic objects (error severity
+    only); the message numbers them with op index, source site and hint."""
+
+    def __init__(self, diagnostics, program_name=None):
+        self.diagnostics = list(diagnostics)
+        self.program_name = program_name
+        head = (f"program verification failed: "
+                f"{len(self.diagnostics)} error(s)")
+        if program_name:
+            head += f" in {program_name}"
+        body = "\n".join(f"  [{i + 1}] {d.format()}"
+                         for i, d in enumerate(self.diagnostics))
+        super().__init__(head + ("\n" + body if body else "") +
+                         "\n(set PADDLE_TPU_VERIFY=0 to skip verification, "
+                         "or run `python -m paddle_tpu analyze` for the "
+                         "full report)")
+
+    def to_dict(self):
+        """JSON-serializable view (flight-recorder crash reports)."""
+        return {
+            "type": type(self).__name__,
+            "message": str(self),
+            "program_name": self.program_name,
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
         }
 
 

@@ -284,6 +284,11 @@ _VLOG_LEVEL = int(os.environ.get("PADDLE_TPU_VLOG", "0") or 0)
 # feed its gauge — PADDLE_TPU_TELEMETRY_FETCH=0 turns them off for
 # latency-critical pipelined loops
 _TELEMETRY_FETCH = os.environ.get("PADDLE_TPU_TELEMETRY_FETCH", "1") == "1"
+# opt-in static verification at first compile (paddle_tpu.analysis): the
+# reference's compile-time InferShape story — error-severity diagnostics
+# raise errors.ProgramVerifyError BEFORE tracing, pointing at the op's
+# Python creation site instead of a JAX traceback
+_VERIFY = os.environ.get("PADDLE_TPU_VERIFY", "0") == "1"
 
 
 _WARNED_CPU_SCAN_CONV = False
@@ -1846,9 +1851,20 @@ class Executor:
         tools/check_registry.py lints this file down to exactly one
         direct jit call site, so a new path can't silently skip it.
         `compiler_options()` returns None (plain compile) off-mesh, off-
-        gate, on non-TPU backends, or when the probe rejects the set."""
+        gate, on non-TPU backends, or when the probe rejects the set.
+
+        State is donated only off-CPU: XLA CPU never aliases donated
+        buffers (the donation audit's alias=0B warning), so donation buys
+        nothing there — and it is actively unsafe when a state entry is a
+        scope-held numpy array, because CPU device_put may zero-copy an
+        aligned host buffer and donating memory jax does not own corrupts
+        the heap (flaky SIGSEGV/garbage reads, alignment- and therefore
+        allocation-order-dependent)."""
         from .parallel import overlap as overlap_mod
-        kwargs: Dict[str, Any] = {"donate_argnums": (1,)}
+        plat = getattr(self.device, "platform", None) or jax.default_backend()
+        kwargs: Dict[str, Any] = {}
+        if plat != "cpu":
+            kwargs["donate_argnums"] = (1,)
         if sh is not None:
             feed_shardings, state_shardings, repl = sh
             kwargs["in_shardings"] = (feed_shardings, state_shardings, repl)
@@ -1857,8 +1873,31 @@ class Executor:
             kwargs["compiler_options"] = opts
         return jax.jit(fn, **kwargs)
 
+    def _maybe_verify(self, program, feed_names, fetch_names):
+        """PADDLE_TPU_VERIFY=1: run the static analyzer once per program
+        version on the cache-miss path (so a verified program costs
+        nothing on later steps) and refuse to trace a program with
+        error-severity diagnostics."""
+        if not _VERIFY:
+            return
+        key = (id(program), getattr(program, "_version", 0))
+        seen = getattr(self, "_verified_programs", None)
+        if seen is None:
+            seen = self._verified_programs = set()
+        if key in seen:
+            return
+        from .analysis import analyze_program
+        report = analyze_program(program, feeds=list(feed_names),
+                                 fetches=list(fetch_names))
+        if report.errors:
+            from .errors import ProgramVerifyError
+            raise ProgramVerifyError(
+                report.errors, program_name=getattr(program, "name", None))
+        seen.add(key)
+
     def _compile(self, program, state_names, feed_names, fetch_names,
                  persist_out, lod_map) -> _CompiledBlock:
+        self._maybe_verify(program, feed_names, fetch_names)
         fn = self._make_step_fn(program, fetch_names, persist_out, lod_map)
         sh = self._shardings(program, state_names, feed_names)
         jitted = self._jit_compile(program, fn, sh)
@@ -1875,6 +1914,7 @@ class Executor:
         parity comes from carrying the same uint32 counter the per-step
         path folds in (step i of the window uses counter+i, bitwise what K
         sequential runs would use)."""
+        self._maybe_verify(program, feed_names, fetch_names)
         step_fn = self._make_step_fn(program, fetch_names, persist_out,
                                      lod_map)
 

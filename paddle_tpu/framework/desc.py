@@ -34,9 +34,11 @@ class VarType(enum.Enum):
 
 
 # Attribute values are restricted to JSON-serializable shapes: bool, int,
-# float, str, lists thereof, and ints naming sub-blocks (reference OpDesc::Attr
-# with BlockDesc attrs, framework.proto:34-63). Block references are stored as
-# {"__block__": idx} so round-trips are unambiguous.
+# float, str, lists/tuples thereof, and ints naming sub-blocks (reference
+# OpDesc::Attr with BlockDesc attrs, framework.proto:34-63). Block references
+# are stored as {"__block__": idx} and tuples as {"__tuple__": [...]} so
+# round-trips are unambiguous — a tuple-valued attr (e.g. an axes pair an op
+# compares with `== (0, 1)`) must come back a tuple, not a list.
 @dataclass
 class BlockRef:
     idx: int
@@ -74,7 +76,9 @@ def _attr_to_json(v: Any) -> Any:
         return {"__block__": v.idx}
     if isinstance(v, BlocksRef):
         return {"__blocks__": v.idxs}
-    if isinstance(v, (list, tuple)):
+    if isinstance(v, tuple):
+        return {"__tuple__": [_attr_to_json(x) for x in v]}
+    if isinstance(v, list):
         return [_attr_to_json(x) for x in v]
     return v
 
@@ -84,7 +88,11 @@ def _attr_from_json(v: Any) -> Any:
         return BlockRef(v["__block__"])
     if isinstance(v, dict) and "__blocks__" in v:
         return BlocksRef(v["__blocks__"])
+    if isinstance(v, dict) and "__tuple__" in v:
+        return tuple(_attr_from_json(x) for x in v["__tuple__"])
     if isinstance(v, list):
+        # pre-__tuple__ JSON stored tuples as bare lists; those load as
+        # lists (the old, lossy behavior) — only new dumps round-trip
         return [_attr_from_json(x) for x in v]
     return v
 

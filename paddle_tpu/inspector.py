@@ -877,6 +877,19 @@ def dump_crash_report(path: Optional[str] = None, *, error=None,
         audit = getattr(program, "_grad_audit", None)
         if audit is not None:
             report["grad_audit"] = audit.report()
+        try:
+            # static analyzer findings: when a trace/run crashed, the
+            # verifier's view of the same program is often the fastest
+            # pointer to the root cause (and it never executes anything)
+            from .analysis import analyze_program
+            areport = analyze_program(program)
+            report["analysis"] = {
+                "counts": areport.counts(),
+                "diagnostics": [d.to_dict()
+                                for d in areport.diagnostics[:50]],
+            }
+        except Exception:
+            pass
     path = path or _RECORDER.path or "paddle_tpu_crash.json"
     d = os.path.dirname(path)
     if d:
@@ -1027,6 +1040,21 @@ def format_crash_report(report: Dict[str, Any], *,
             detail = (f" l2={info['l2']:.4g}" if "l2" in info else
                       f" ({info.get('reason', '')})")
             lines.append(f"  {param}: {info.get('status')}{detail}")
+    analysis = report.get("analysis") or {}
+    if analysis:
+        c = analysis.get("counts") or {}
+        lines.append(f"static analysis: {c.get('error', 0)} error(s), "
+                     f"{c.get('warning', 0)} warning(s), "
+                     f"{c.get('info', 0)} info")
+        for d in (analysis.get("diagnostics") or []):
+            if d.get("severity") == "info":
+                continue
+            where = (f" [op {d['op_index']} '{d.get('op_type')}']"
+                     if d.get("op_index") is not None else
+                     f" [var '{d['var']}']" if d.get("var") else "")
+            site = f" ({d['site']})" if d.get("site") else ""
+            lines.append(f"  {d.get('severity')}: {d.get('code')}"
+                         f"{where}{site}: {d.get('message')}")
     events = report.get("events") or []
     if events:
         counts: Dict[str, int] = {}

@@ -88,6 +88,22 @@ def registered_ops() -> List[str]:
     return sorted(_REGISTRY)
 
 
+def static_infer(type: str):
+    """The shape-inference rule the static analyzer should use for `type`
+    (analysis/infer.py keys its shapes pass off this): the registered
+    infer_shape when there is one, the generic grad mirror for any
+    `<base>_grad` of a registered base — including explicitly registered
+    grad ops like dropout_grad whose build-time infer_shape is None — or
+    None. Unlike try_get this never mutates the registry, so lints can
+    probe coverage without materializing lazy grad entries."""
+    d = _REGISTRY.get(type)
+    if d is not None and d.infer_shape is not None:
+        return d.infer_shape
+    if type.endswith("_grad") and type[: -len("_grad")] in _REGISTRY:
+        return infer_grad_shapes
+    return None
+
+
 # ---------------------------------------------------------------------------
 # Generic gradient machinery
 # ---------------------------------------------------------------------------

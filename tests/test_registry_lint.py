@@ -109,6 +109,55 @@ def test_pallas_lint_catches_missing_grad(monkeypatch):
     assert any("geometry" in m for _, m in problems), problems
 
 
+def test_infer_rules_cover_registry():
+    """ISSUE 12 satellite: every registered op resolves to exactly one
+    shape-rule source in analysis/infer.py (checker, registry
+    infer_shape, eval-shape probe, or the dynamic allowlist). An
+    uncovered op makes the shapes pass silently mark everything
+    downstream unknown."""
+    problems = _load_checker().check_infer_rules()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_infer_lint_catches_uncovered_op(monkeypatch):
+    """Sanity: registering an op with no infer rule trips the coverage
+    direction of the lint."""
+    from paddle_tpu.ops import registry
+
+    checker = _load_checker()
+    orig = registry.registered_ops
+
+    def with_phantom():
+        return list(orig()) + ["definitely_uncovered_op"]
+
+    monkeypatch.setattr(registry, "registered_ops", with_phantom)
+    problems = checker.check_infer_rules()
+    assert any("definitely_uncovered_op" in m and "no shape rule" in m
+               for _, m in problems), problems
+
+
+def test_infer_lint_catches_orphan_and_overlap(monkeypatch):
+    """Sanity: a table entry for an unregistered op is an orphan, and
+    the same op in two tables trips the precedence check."""
+    from paddle_tpu.analysis import infer
+
+    checker = _load_checker()
+    monkeypatch.setattr(
+        infer, "DYNAMIC_SHAPE_OPS",
+        infer.DYNAMIC_SHAPE_OPS | {"definitely_not_an_op"})
+    problems = checker.check_infer_rules()
+    assert any("definitely_not_an_op" in m and "orphan" in m
+               for _, m in problems), problems
+
+    overlap_op = next(iter(infer.EVAL_SHAPE_OPS))
+    monkeypatch.setattr(
+        infer, "DYNAMIC_SHAPE_OPS",
+        infer.DYNAMIC_SHAPE_OPS | {overlap_op})
+    problems = checker.check_infer_rules()
+    assert any(overlap_op in m and "precedence" in m
+               for _, m in problems), problems
+
+
 def test_cli_passes():
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     r = subprocess.run(

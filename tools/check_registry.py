@@ -29,6 +29,14 @@ The Pallas-table lint (ISSUE 11 satellite) pins pallas_conv.KERNELS the
 same way: orphan kernels, conv window kinds without a dispatch entry,
 forward kernels missing their grad twin (the shared-gate/vjp contract),
 and fallback reasons the gate produces but FALLBACK_REASONS omits.
+
+The infer-rules lint (ISSUE 12 satellite) pins the static analyzer's
+shape-pass coverage: every registered op must resolve to exactly one
+rule source (a hand-written analysis CHECKER, the registry's own
+infer_shape, the jax.eval_shape fallback list, or the explicit
+DYNAMIC_SHAPE_OPS allowlist) — a newly registered op with no rule makes
+the analyzer silently blind to everything downstream of it. Orphan
+entries in the analysis tables are flagged in the converse direction.
 """
 
 import sys
@@ -261,6 +269,50 @@ def check_pallas_table():
     return problems
 
 
+def check_infer_rules():
+    """[(where, message), ...] — pin the static analyzer's shape-pass
+    coverage (ISSUE 12) against ops/registry.py. Every registered op
+    must be covered by one of analysis/infer.py's rule sources
+    (`rule_kind` != None); an uncovered op makes the shapes pass mark
+    all downstream shapes unknown without any test noticing. Conversely,
+    names in the analysis tables that aren't registered are typos: the
+    rule silently never fires. Overlap between the explicit tables is
+    flagged too — precedence would hide one of the entries."""
+    from paddle_tpu.analysis import infer
+    from paddle_tpu.ops import registry
+
+    problems = []
+    registered = set(registry.registered_ops())
+    for t in sorted(registered):
+        if infer.rule_kind(t) is None:
+            problems.append((
+                "analysis.infer",
+                f"registered op '{t}' has no shape rule: add a CHECKER, "
+                f"a registry infer_shape, or list it in EVAL_SHAPE_OPS / "
+                f"DYNAMIC_SHAPE_OPS"))
+    tables = {
+        "analysis.DYNAMIC_SHAPE_OPS": infer.DYNAMIC_SHAPE_OPS,
+        "analysis.EVAL_SHAPE_OPS": infer.EVAL_SHAPE_OPS,
+        "analysis.CHECKERS": set(infer.CHECKERS),
+    }
+    for tname in sorted(tables):
+        for name in sorted(tables[tname]):
+            base = name[:-5] if name.endswith("_grad") else name
+            if base not in registered:
+                problems.append((
+                    tname, f"'{name}' is not registered in "
+                           f"ops/registry.py — orphan rule entry"))
+    for a in sorted(tables):
+        for b in sorted(tables):
+            if a >= b:
+                continue
+            for name in sorted(tables[a] & tables[b]):
+                problems.append((
+                    a, f"'{name}' also listed in {b} — rule-source "
+                       f"precedence hides one of them"))
+    return problems
+
+
 def main():
     problems = check_tables()
     for tname, name in problems:
@@ -277,7 +329,10 @@ def main():
     pallas = check_pallas_table()
     for where, msg in pallas:
         print(f"{where}: {msg}")
-    problems = problems + coll + jit + sparse + pallas
+    inferp = check_infer_rules()
+    for where, msg in inferp:
+        print(f"{where}: {msg}")
+    problems = problems + coll + jit + sparse + pallas + inferp
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")

@@ -251,8 +251,26 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
             if emb_cfg is not None:
                 perf.update(_embedding_fields(
                     main, emb_cfg, batch * steps / dt))
+            perf.update(_analyze_fields(main))
     assert np.isfinite(final)
     return batch * steps / dt, peak_hbm, perf, k
+
+
+def _analyze_fields(main):
+    """analyze_errors / analyze_warnings for the per-mesh JSON line (same
+    contract as bench.py): one static-verifier pass over the measured
+    program. SCALE_ANALYZE=0 skips; failures degrade to no fields."""
+    if os.environ.get("SCALE_ANALYZE", "1") != "1":
+        return {}
+    try:
+        from paddle_tpu.analysis import analyze_program
+
+        counts = analyze_program(main).counts()
+        return {"analyze_errors": counts.get("error", 0),
+                "analyze_warnings": counts.get("warning", 0)}
+    except Exception as e:  # noqa: BLE001 - advisory, never kills the line
+        print(f"static analysis skipped: {e}", file=sys.stderr)
+        return {}
 
 
 def _embedding_fields(main, emb_cfg, examples_per_sec):
