@@ -1141,6 +1141,128 @@ def main_embedding():
     }, errors)
 
 
+def main_serving():
+    """Inference serving family (ISSUE 13): ServingEngine (AOT per-bucket
+    executables) + DynamicBatcher under concurrent client threads, a
+    normal phase at N clients then a 2x overload phase against the
+    bounded queue. The JSON line is the serving trajectory's unit record:
+    p50_ms/p99_ms (end-to-end request latency), qps, shed_fraction,
+    bucket_hits (which ladder rungs actually ran), and goodput_fraction
+    under overload — reject-not-collapse means the overload phase should
+    show shed_fraction > 0 with accepted requests still completing,
+    rather than p99 exploding. BENCH_SERVE_MODEL picks fc (default),
+    dlrm (fsdp-sharded sparse table; densify must stay 0 at serve time),
+    or transformer (token-level latency)."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import executor as executor_mod, models, telemetry
+    from paddle_tpu.serving import DynamicBatcher, ServingEngine, run_load
+
+    model = os.environ.get("BENCH_SERVE_MODEL", "fc")
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "4"))
+    requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "16"))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "16"))
+    delay_ms = float(os.environ.get("BENCH_SERVE_DELAY_MS", "3.0"))
+    queue_depth = int(os.environ.get("BENCH_SERVE_QUEUE_DEPTH", "32"))
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        if model == "dlrm":
+            rows, dim, slots = 100000, 32, 26
+            ids = fluid.layers.data(name="ids", shape=[slots],
+                                    dtype="int64")
+            emb = fluid.layers.embedding(
+                ids, size=[rows, dim], is_sparse=True,
+                param_attr=fluid.ParamAttr(name="emb_table"))
+            flat = fluid.layers.reshape(emb, shape=[-1, slots * dim])
+            h = fluid.layers.fc(input=flat, size=256, act="relu")
+            h = fluid.layers.fc(input=h, size=64, act="relu")
+            out = fluid.layers.softmax(fluid.layers.fc(input=h, size=2))
+            feeds, fetches = ["ids"], [out.name]
+        elif model == "transformer":
+            seqlen, vocab = 128, 1024
+            tok = fluid.layers.data(name="tok", shape=[-1, seqlen],
+                                    dtype="int64",
+                                    append_batch_size=False)
+            lab = fluid.layers.data(name="lab", shape=[-1, seqlen],
+                                    dtype="int64",
+                                    append_batch_size=False)
+            _loss, logits = models.transformer_lm(
+                tok, lab, vocab_size=vocab, d_model=128, n_head=2,
+                n_layer=2, is_test=True, return_logits=True)
+            feeds, fetches = ["tok"], [logits.name]
+        else:
+            x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+            h = fluid.layers.fc(input=x, size=256, act="relu")
+            h = fluid.layers.fc(input=h, size=64, act="relu")
+            out = fluid.layers.fc(input=h, size=8)
+            feeds, fetches = ["x"], [out.name]
+    if model == "dlrm":
+        from paddle_tpu.parallel import embedding as emb_mod
+        from paddle_tpu.parallel.mesh import make_mesh
+        main_prog._mesh = make_mesh((len(jax.devices()),), ("fsdp",))
+        emb_mod.shard_table(main_prog, "emb_table", "fsdp")
+
+    scope = executor_mod.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with executor_mod.scope_guard(scope):
+        exe.run(startup)
+    engine = ServingEngine(main_prog, feed_names=feeds,
+                           fetch_names=fetches, scope=scope,
+                           max_batch=max_batch)
+    rng = np.random.default_rng(0)
+    rows_choices = [1, 2, 3, max(1, max_batch // 4)]
+
+    def rand_feed(n):
+        feed = {}
+        for name, (shape, dtype) in engine._feed_meta.items():
+            dims = (n,) + tuple(8 if d == -1 else d for d in shape[1:])
+            if np.issubdtype(dtype, np.integer):
+                feed[name] = rng.integers(0, 8, dims).astype(dtype)
+            else:
+                feed[name] = rng.standard_normal(dims).astype(dtype)
+        return feed
+
+    def make_feed(ci, ri):
+        return rand_feed(rows_choices[(ci + ri) % len(rows_choices)])
+
+    errors = []
+    batcher = DynamicBatcher(engine, max_delay_ms=delay_ms,
+                             max_queue_depth=queue_depth).start()
+    try:
+        # bucket warm-up outside the timed phases: compile, don't measure
+        for n in sorted({engine.bucket_for(r) for r in rows_choices}):
+            engine.run_batch(rand_feed(n))
+        normal = run_load(batcher, make_feed, clients=clients,
+                          requests_per_client=requests, label="normal")
+        overload = run_load(batcher, make_feed, clients=2 * clients,
+                            requests_per_client=requests,
+                            deadline_ms=max(delay_ms * 8, 50.0),
+                            label="overload")
+    finally:
+        batcher.stop()
+    densify = telemetry.read_series("sparse_densify_fallback_total")
+    _emit({
+        "metric": "serving_p50_ms",
+        "value": normal["p50_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "p50_ms": normal["p50_ms"], "p99_ms": normal["p99_ms"],
+        "qps": round(normal["qps"], 1),
+        "shed_fraction": normal["shed_fraction"],
+        "bucket_hits": normal["bucket_hits"],
+        "goodput_fraction": normal["goodput_fraction"],
+        "overload": {k: overload[k] for k in
+                     ("p50_ms", "p99_ms", "qps", "shed_fraction",
+                      "bucket_hits", "goodput_fraction")},
+        "model": model, "clients": clients, "max_batch": max_batch,
+        "compile_cache": {"hits": engine.cache_hits,
+                          "misses": engine.cache_misses},
+        "densify_fallbacks": sum(densify.values()),
+    }, errors)
+    engine.close()
+
+
 def _dispatch(mode):
     if mode == "fc":
         return main_fc()
@@ -1154,6 +1276,8 @@ def _dispatch(mode):
         return main_ring_attention()
     if mode == "embedding":
         return main_embedding()
+    if mode == "serving":
+        return main_serving()
     family, _, job = mode.partition("_")
     if family not in CNN or job not in ("", "infer"):
         raise SystemExit(f"unknown BENCH_MODE={mode}")

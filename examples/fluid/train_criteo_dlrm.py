@@ -41,10 +41,13 @@ def build(rows, dim, slots):
     h = fluid.layers.fc(input=flat, size=256, act="relu")
     h = fluid.layers.fc(input=h, size=64, act="relu")
     logits = fluid.layers.fc(input=h, size=2)
+    # prob is the serving fetch: pruning to it drops label/loss/backward
+    # while keeping the sparse lookup -> MLP forward chain
+    prob = fluid.layers.softmax(logits)
     loss = fluid.layers.mean(
         fluid.layers.softmax_with_cross_entropy(logits, label))
     fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
-    return loss
+    return loss, prob
 
 
 def build_programs(rows=100000, dim=64, slots=26):
@@ -53,10 +56,11 @@ def build_programs(rows=100000, dim=64, slots=26):
     fresh programs instead of the defaults."""
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
-        loss = build(rows, dim, slots)
+        loss, prob = build(rows, dim, slots)
     return {"main": main_prog, "startup": startup,
-            "feeds": ["ids", "label"], "fetches": [loss.name],
-            "loss": loss}
+            "feeds": ["ids", "label"], "fetches": [loss.name, prob.name],
+            "loss": loss,
+            "infer_feeds": ["ids"], "infer_fetches": [prob.name]}
 
 
 def synthetic_clicks(rng, batch, rows, slots):
@@ -79,7 +83,7 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=30)
     args = p.parse_args(argv)
 
-    loss = build(args.rows, args.dim, args.slots)
+    loss, _prob = build(args.rows, args.dim, args.slots)
     main_prog = fluid.default_main_program()
     if args.sharded:
         import jax

@@ -29,9 +29,10 @@ def build_programs(use_ring=False, seqlen=512, vocab=1024):
                                 dtype="int64", append_batch_size=False)
         lab = fluid.layers.data(name="lab", shape=[-1, seqlen],
                                 dtype="int64", append_batch_size=False)
-        loss = models.transformer_lm(
+        loss, logits = models.transformer_lm(
             tok, lab, vocab_size=vocab, d_model=128, n_head=2, n_layer=2,
-            use_flash=not use_ring, sequence_parallel=use_ring)
+            use_flash=not use_ring, sequence_parallel=use_ring,
+            return_logits=True)
         fluid.optimizer.Adam(learning_rate=3e-4).minimize(
             loss, startup_program=startup)
     if use_ring:
@@ -39,7 +40,10 @@ def build_programs(use_ring=False, seqlen=512, vocab=1024):
         from paddle_tpu.parallel import mesh as mesh_mod
         main_prog._mesh = mesh_mod.make_mesh((len(jax.devices()),), ("sp",))
     return {"main": main_prog, "startup": startup,
-            "feeds": ["tok", "lab"], "fetches": [loss.name], "loss": loss}
+            "feeds": ["tok", "lab"], "fetches": [loss.name], "loss": loss,
+            # serving surface: prune to the pre-softmax logits, feeding
+            # tokens only (token-level latency scenario)
+            "infer_feeds": ["tok"], "infer_fetches": [logits.name]}
 
 
 def main(use_ring=False):

@@ -51,6 +51,7 @@ from . import concurrency
 from .concurrency import (make_channel, channel_send, channel_recv,
                           channel_close, Go, Select)
 from . import telemetry
+from . import serving
 from . import inspector
 from . import roofline
 from .parallel import transpiler

@@ -60,7 +60,13 @@ def run(pctx):
             if n in defined or n not in declared:
                 continue
             later = [j for j in producers.get(n, []) if j >= i]
-            if later:
+            if later and later[0] == i and n in _writes(op):
+                # in-place accumulator (write_to_array appending to the
+                # array it reads, increment counters): the op is its own
+                # only producer — the value starts implicitly empty/zero,
+                # not garbage, so this is not a use-before-def
+                pass
+            elif later:
                 pctx.emit(
                     "error", "use-before-def",
                     f"reads '{n}' which is only produced later, by op "

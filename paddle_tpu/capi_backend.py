@@ -3,10 +3,16 @@ drives this module; reference: paddle/capi/gradient_machine.h fronted the
 C++ GradientMachine the same way, with paddle_arguments carrying value
 matrices, integer id vectors, and sequence_start_positions).
 
-Machine wraps load_inference_model + a private scope; inputs arrive as raw
-bytes + dims + dtype tag from C (0=f32, 1=i64, 2=i32 — capi.h
-paddle_tpu_dtype), optional level-1 LoD offsets attach per input, outputs
-go back as float32 bytes."""
+Machine is now a thin handle over `serving.ServingEngine` — the C API's
+create/feed/fetch/destroy lifecycle maps onto engine construction
+(load_inference_model into a private scope + AOT bucket cache),
+`engine.infer` (dense inputs ride the bucketed AOT executables; LoD
+inputs fall back to the classic executor on the same pruned program, as
+`serving_fallback_total{reason="lod"}` records), and `destroy()`
+(drop executables + resident device state). Inputs arrive as raw bytes +
+dims + dtype tag from C (0=f32, 1=i64, 2=i32 — capi.h paddle_tpu_dtype),
+optional level-1 LoD offsets attach per input, outputs go back as float32
+bytes."""
 
 from __future__ import annotations
 
@@ -21,20 +27,19 @@ class Machine:
     def __init__(self, model_dir: str):
         import paddle_tpu as fluid
         from paddle_tpu import executor as executor_mod
+        from paddle_tpu.serving import ServingEngine
 
-        self._fluid = fluid
         self._executor_mod = executor_mod
-        self._scope = executor_mod.Scope()
-        self._exe = fluid.Executor(fluid.CPUPlace())
-        with executor_mod.scope_guard(self._scope):
-            (self._program, self._feed_names,
-             self._fetch_targets) = fluid.io.load_inference_model(
-                model_dir, self._exe)
+        self._engine = ServingEngine(model_dir,
+                                     place=fluid.CPUPlace())
+        self._feed_names = list(self._engine.feed_names)
         self._inputs: Dict[str, np.ndarray] = {}
         self._lods: Dict[str, list] = {}
 
     def set_input(self, name: str, payload: bytes, dims: Tuple[int, ...],
                   dtype: int = 0):
+        if self._engine.closed:
+            raise RuntimeError("Machine has been destroyed")
         if name not in self._feed_names:
             raise KeyError(
                 f"'{name}' is not a feed of this model; feeds: "
@@ -65,11 +70,21 @@ class Machine:
                 feed[n] = self._executor_mod.LoDTensor(arr, [self._lods[n]])
             else:
                 feed[n] = arr
-        with self._executor_mod.scope_guard(self._scope):
-            outs = self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_targets)
+        outs = self._engine.infer(feed)
         result = []
         for o in outs:
             a = np.ascontiguousarray(np.asarray(o), dtype=np.float32)
             result.append((a.tobytes(), tuple(int(d) for d in a.shape)))
         return result
+
+    def destroy(self):
+        """paddle_tpu_machine_destroy: release executables + device state.
+        Idempotent; further set_input/forward calls raise."""
+        self._engine.close()
+        self._inputs.clear()
+        self._lods.clear()
+
+    @property
+    def engine(self):
+        """The backing ServingEngine (bucket/cache stats for tests)."""
+        return self._engine

@@ -513,6 +513,114 @@ def cmd_analyze(args):
     return rc
 
 
+def _serve_engine(args):
+    """-> (engine, label) from --model-dir / --example / --smoke. Examples
+    must export infer_feeds/infer_fetches from build_programs() (the
+    serving surface the two flagship examples ship); --smoke builds a tiny
+    in-process fc scorer so the command works on a bare checkout."""
+    import numpy as np  # noqa: F401
+    import paddle_tpu as fluid
+    from paddle_tpu import executor as executor_mod
+    from paddle_tpu.serving import ServingEngine
+
+    if args.model_dir:
+        return ServingEngine(args.model_dir, max_batch=args.max_batch), \
+            args.model_dir
+    if args.example:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        name = args.example
+        path = name if os.path.exists(name) else os.path.join(
+            root, "examples", "fluid", f"train_{name}.py")
+        if not os.path.exists(path):
+            raise SystemExit(f"no such example: {args.example} "
+                             f"(looked for {path})")
+        spec_ = importlib.util.spec_from_file_location(
+            "paddle_tpu_serve_example", path)
+        mod = importlib.util.module_from_spec(spec_)
+        spec_.loader.exec_module(mod)
+        built = mod.build_programs()
+        if not built.get("infer_feeds") or not built.get("infer_fetches"):
+            raise SystemExit(
+                f"example '{path}' exports no serving surface "
+                f"(build_programs() must return infer_feeds/infer_fetches)")
+        scope = executor_mod.Scope()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        with executor_mod.scope_guard(scope):
+            exe.run(built["startup"])
+        return ServingEngine(built["main"],
+                             feed_names=built["infer_feeds"],
+                             fetch_names=built["infer_fetches"],
+                             scope=scope, max_batch=args.max_batch), \
+            os.path.basename(path)
+    # --smoke: x[16] -> fc(32, relu) -> fc(4): compiles in well under a
+    # second per bucket, exercises the whole ladder/batcher/shed stack
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=4)
+    scope = executor_mod.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with executor_mod.scope_guard(scope):
+        exe.run(startup)
+    return ServingEngine(main, feed_names=["x"], fetch_names=[pred.name],
+                         scope=scope, max_batch=args.max_batch), "smoke"
+
+
+def _serve_random_feed(engine, rng, rows):
+    """Feed generator off the engine's declared feed geometry: ints draw
+    from a small id range (valid for any vocab/table), floats from N(0,1);
+    -1 inner dims (rare) default to 8."""
+    import numpy as np
+    feed = {}
+    for name, (shape, dtype) in engine._feed_meta.items():
+        dims = (rows,) + tuple(8 if d == -1 else d for d in shape[1:])
+        if np.issubdtype(dtype, np.integer):
+            feed[name] = rng.integers(0, 8, dims).astype(dtype)
+        else:
+            feed[name] = rng.standard_normal(dims).astype(dtype)
+    return feed
+
+
+def cmd_serve(args):
+    """Concurrent-client serving benchmark: `python -m paddle_tpu serve
+    --smoke` (or --example criteo_dlrm / --model-dir DIR). Spins up the
+    ServingEngine + DynamicBatcher, drives a normal phase at N clients and
+    an overload phase at 2N against the bounded queue, and prints one JSON
+    line per phase with p50_ms/p99_ms/qps/shed_fraction/bucket_hits/
+    goodput_fraction, plus an engine/batcher summary line."""
+    import json
+
+    import numpy as np
+    from paddle_tpu.serving import DynamicBatcher, run_load
+
+    engine, label = _serve_engine(args)
+    rng = np.random.default_rng(0)
+    rows_choices = [1, 2, 3, max(1, args.max_batch // 4)]
+
+    def make_feed(ci, ri):
+        rows = rows_choices[(ci + ri) % len(rows_choices)]
+        return _serve_random_feed(engine, rng, rows)
+
+    batcher = DynamicBatcher(engine, max_delay_ms=args.max_delay_ms,
+                             max_queue_depth=args.max_queue_depth).start()
+    try:
+        for phase, clients in (("normal", args.clients),
+                               ("overload", 2 * args.clients)):
+            payload = run_load(batcher, make_feed, clients=clients,
+                               requests_per_client=args.requests,
+                               deadline_ms=args.deadline_ms, label=phase)
+            payload["model"] = label
+            print(json.dumps(payload, sort_keys=True))
+    finally:
+        batcher.stop()
+        summary = {"model": label, "engine": engine.stats(),
+                   "batcher": batcher.stats()}
+        print(json.dumps(summary, sort_keys=True))
+        engine.close()
+    return 0
+
+
 def cmd_version(_args):
     import paddle_tpu
     import jax
@@ -815,6 +923,37 @@ def main(argv=None):
     p_an.add_argument("--no-info", action="store_true",
                       help="hide info-severity advisories")
     p_an.set_defaults(fn=cmd_analyze)
+
+    p_srv = sub.add_parser(
+        "serve", help="serving benchmark: AOT bucket cache + dynamic "
+                      "batcher + load shedding under concurrent clients "
+                      "(normal phase, then 2x overload); JSON line per "
+                      "phase with p50/p99/qps/shed/goodput")
+    p_srv.add_argument("--smoke", action="store_true",
+                       help="serve a tiny built-in fc scorer (default "
+                            "when neither --example nor --model-dir)")
+    p_srv.add_argument("--example", default=None,
+                       help="a shipped example exporting a serving "
+                            "surface: criteo_dlrm or "
+                            "transformer_long_context")
+    p_srv.add_argument("--model-dir", default=None,
+                       help="a save_inference_model directory")
+    p_srv.add_argument("--clients", type=int, default=4,
+                       help="concurrent client threads in the normal "
+                            "phase (overload runs 2x; default 4)")
+    p_srv.add_argument("--requests", type=int, default=16,
+                       help="requests per client per phase (default 16)")
+    p_srv.add_argument("--max-batch", type=int, default=16,
+                       help="top of the padded-bucket ladder (default 16)")
+    p_srv.add_argument("--max-delay-ms", type=float, default=3.0,
+                       help="batch-close deadline in ms (default 3)")
+    p_srv.add_argument("--max-queue-depth", type=int, default=32,
+                       help="bounded queue: requests beyond this shed "
+                            "with ServingOverloadError (default 32)")
+    p_srv.add_argument("--deadline-ms", type=float, default=None,
+                       help="per-request deadline; expired requests are "
+                            "shed instead of executed (default none)")
+    p_srv.set_defaults(fn=cmd_serve)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

@@ -8,7 +8,7 @@ reference paddle/utils/CustomStackTrace.h layer-stack dump)."""
 from __future__ import annotations
 
 __all__ = ["EnforceNotMet", "EOFException", "NonFiniteError", "NotFoundError",
-           "OOMError", "ProgramVerifyError"]
+           "OOMError", "ProgramVerifyError", "ServingOverloadError"]
 
 
 class EnforceNotMet(RuntimeError):
@@ -142,6 +142,32 @@ class ProgramVerifyError(RuntimeError):
 
 class NotFoundError(KeyError):
     """A variable/operator lookup failed (reference NotFound error code)."""
+
+
+class ServingOverloadError(RuntimeError):
+    """A serving request was rejected by overload control (serving/batcher):
+    either the bounded request queue was full at submit time, or the
+    request's deadline expired before its batch reached the device.
+    Shedding with a typed error keeps the accepted requests' latency bounded
+    instead of letting the queue collapse under 2x load — the caller is
+    expected to retry against another replica or surface the rejection.
+
+    `reason` is the shed cause ("queue_full" | "deadline" | "shutdown"),
+    `queue_depth` the depth observed at rejection."""
+
+    def __init__(self, message, reason=None, queue_depth=None):
+        super().__init__(message)
+        self.reason = reason
+        self.queue_depth = queue_depth
+
+    def to_dict(self):
+        """JSON-serializable view (flight-recorder crash reports)."""
+        return {
+            "type": type(self).__name__,
+            "message": str(self),
+            "reason": self.reason,
+            "queue_depth": self.queue_depth,
+        }
 
 
 def __getattr__(name):

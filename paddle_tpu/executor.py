@@ -1904,6 +1904,28 @@ class Executor:
         return _CompiledBlock(jitted, state_names, feed_names, fetch_names,
                               program)
 
+    def prepare_serving(self, program, feed_names, fetch_names, scope):
+        """Compile one inference program for the serving engine and return
+        (compiled_block, state_names, persist_out). This is the stable
+        seam between serving/ and the executor: the engine AOT-lowers
+        per-bucket executables from compiled_block.fn (jit's .lower() on
+        explicit avals) instead of re-implementing tracing, sharding
+        resolution, or the donation contract. Raises the same
+        missing-state error as Executor.run when a persistable the block
+        reads has no value in `scope` (startup never ran / load_persistables
+        skipped a file)."""
+        feed_names = sorted(feed_names)
+        state_names = self._external_inputs(program, set(feed_names), scope)
+        persist_out = self._persistable_outputs(program)
+        missing = [n for n in state_names if scope.find_var(n) is None]
+        if missing:
+            raise RuntimeError(
+                f"Variables {missing} are read by the program but absent "
+                f"from the scope — run the startup program first.")
+        compiled = self._compile(program, state_names, feed_names,
+                                 fetch_names, persist_out, lod_map={})
+        return compiled, state_names, persist_out
+
     def _compile_window(self, program, state_names, feed_names, fetch_names,
                         persist_out, lod_map, steps, fetch_mode) \
             -> _CompiledBlock:

@@ -458,7 +458,7 @@ class Program:
         # AMP policy, bound reader pipelines
         p._mesh = getattr(self, "_mesh", None)
         for attr in ("_amp_dtype", "_amp_level", "_pipeline_readers",
-                     "_param_shardings", "_feed_shardings",
+                     "_param_shardings", "_feed_shardings", "_sharded_tables",
                      # observability state: telemetry side-fetch marks, loss
                      # names recorded by append_backward, inspector probe
                      # sites / audit / internal-run marker — all describe the
@@ -503,6 +503,17 @@ class Program:
 
         Keeps, in the root block, only ops on a path to `fetches` given that
         `feeds` are externally provided.
+
+        Backward/optimize-role ops reached only through an in-place
+        persistable update are dropped: an optimizer op writes ParamOut
+        aliasing the parameter, so a fetch built after minimize() sees it
+        as the parameter's producer and the reverse walk would drag the
+        whole training tail — gradients, moments, beta pows — into the
+        inference slice, leaving dead opt-state persistables the dead-var
+        pass then flags. The pre-update value is what an inference slice
+        wants; the parameter stays a state leaf. A training-role op that
+        is the sole producer of a needed NON-persistable (an explicitly
+        fetched gradient) is still kept.
         """
         pruned = self.clone()
         block = pruned.global_block()
@@ -510,11 +521,19 @@ class Program:
         def op_reads(op):
             return op_external_reads(pruned, op)
 
+        def _persistable(name):
+            return block.desc.has_var(name) and \
+                block.desc.var(name).persistable
+
         needed = set(fetches)
         keep: List[int] = []
         for i in range(len(block.ops) - 1, -1, -1):
             op = block.ops[i]
-            if needed & set(op.output_arg_names):
+            hit = needed & set(op.output_arg_names)
+            if hit:
+                if op.desc.attrs.get("op_role") in ("backward", "optimize") \
+                        and all(_persistable(n) for n in hit):
+                    continue
                 keep.append(i)
                 for name in op_reads(op):
                     if name not in feeds:

@@ -207,6 +207,32 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
     for op_ in block.ops:
         live |= op_external_reads(inference_program, op_)
     feed_names = [n for n in feeded_var_names if n in live]
+    # serving admission starts here: a saved model must never carry the
+    # training tail (prune's training-role skip + the strip above make
+    # this unreachable unless a grad var was requested as a target)
+    leaked = [op_.type for op_ in block.ops
+              if op_.desc.attrs.get("op_role") in ("backward", "optimize")
+              or op_.type.endswith("_grad")]
+    if leaked:
+        raise ValueError(
+            f"save_inference_model: training-only ops {leaked} survived "
+            f"pruning — a target var appears to be a gradient/optimizer "
+            f"output, which is not an inference fetch")
+    # a gradient target doesn't leak its producer (the strip above removed
+    # it) — it leaves an UNCOMPUTABLE fetch instead: no surviving op writes
+    # it and it's neither a feed nor a persistable, so the saved model
+    # would only fail at first serve compile. Refuse at export time.
+    produced = {n for op_ in block.ops for n in op_.output_arg_names}
+    for t in target_vars:
+        v = block.desc.vars.get(t.name)
+        if (t.name not in produced and t.name not in feeded_var_names
+                and not (v is not None and v.persistable)):
+            what = ("a gradient" if t.name.endswith("@GRAD")
+                    or t.name.endswith("_grad") else "not computable")
+            raise ValueError(
+                f"save_inference_model: target '{t.name}' is {what} — "
+                f"its producer was stripped with the training tail, so "
+                f"the inference program cannot compute it from the feeds")
     meta = {
         "program": inference_program.to_json(),
         "feed_names": feed_names,

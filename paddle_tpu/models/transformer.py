@@ -17,13 +17,19 @@ from ..param_attr import ParamAttr
 
 def transformer_lm(tokens, labels, vocab_size, d_model=512, n_head=8,
                    n_layer=4, ffn_mult=4, dropout_prob=0.0, is_test=False,
-                   use_flash="auto", sequence_parallel=False):
+                   use_flash="auto", sequence_parallel=False,
+                   return_logits=False):
     """tokens/labels [B, T] int -> mean next-token cross-entropy loss.
 
     Pre-LN residual blocks: x += Wo·attn(LN(x)); x += W2·gelu(W1·LN(x)).
     Causal attention over [B, T, H, D] via fused_attention, so one flag
     flips the whole model between the XLA einsum path, the Pallas flash
-    kernels, and ring sequence parallelism."""
+    kernels, and ring sequence parallelism.
+
+    With return_logits=True returns (loss, logits) where logits is the
+    pre-softmax [B, T, V] head output — the inference fetch the serving
+    subsystem prunes to (token-level latency scenario); the training tail
+    hangs off loss only, so pruning to logits drops it entirely."""
     seqlen = int(tokens.shape[-1])
     d_head = d_model // n_head
     assert d_head * n_head == d_model
@@ -63,4 +69,7 @@ def transformer_lm(tokens, labels, vocab_size, d_model=512, n_head=8,
     flat = layers.reshape(logits, [-1, vocab_size])
     lab = layers.reshape(labels, [-1, 1])
     loss = layers.softmax_with_cross_entropy(logits=flat, label=lab)
-    return layers.mean(loss)
+    mean_loss = layers.mean(loss)
+    if return_logits:
+        return mean_loss, logits
+    return mean_loss

@@ -1,0 +1,399 @@
+"""AOT inference serving engine (reference: the fluid inference library —
+paddle/fluid/inference/io.cc pruned-ProgramDesc loading + the capi
+GradientMachine serving surface; here re-imagined TPU-natively).
+
+`ServingEngine` owns one pruned inference program end to end:
+
+  * **Admission**: the program is pruned to the inference fetch set
+    (`Program.prune` drops the training tail, including in-place optimizer
+    updates), cloned `for_test`, and gated through the static analyzer —
+    an error-severity diagnostic or a leaked training-only op refuses to
+    serve rather than compile a broken artifact.
+  * **AOT program cache**: one XLA executable per padded batch-size bucket
+    (powers-of-two ladder by default), produced by `jit(fn).lower(avals)
+    .compile()` — the same AOT pattern the executor's static memory
+    analysis uses — and LRU-evicted under `cache_capacity`. Compiles are
+    booked in `serving_compile_seconds`; lookups in
+    `serving_cache_{hit,miss}_total{bucket=}`.
+  * **Resident state**: persistable weights are device-put once at engine
+    construction and thereafter round-trip through the executable's
+    donated state argument (donation only off-CPU, matching
+    Executor._jit_compile's contract) — serving never re-uploads weights.
+    On a meshed program (fsdp-sharded DLRM tables) the first call shards
+    host state per the program's in_shardings and the sharded device
+    arrays become the residents.
+
+Requests with LoD inputs (sequence models through the C-API) fall back to
+the classic Executor.run path on the same pruned program — counted in
+`serving_fallback_total{reason=}`, never silently.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..errors import ProgramVerifyError
+
+DEFAULT_MAX_BATCH = 64
+
+#: Op roles that must never appear in a served program. Op types are
+#: checked structurally too (tools/check_registry.check_serving): a
+#: `*_grad` suffix or an optimizer bucket type is training-only even when
+#: an op_role attribute was lost along the way.
+TRAINING_ONLY_ROLES = ("backward", "optimize")
+
+
+def training_only_op_types() -> frozenset:
+    """Op types that only make sense while training: every optimizer the
+    fusion pass knows how to bucket, their fused/sparse twins, and the
+    grad-accumulation helpers. Gradient ops are matched by their `_grad`
+    suffix via `is_training_only_op` instead of enumeration."""
+    from ..ops import fusion
+    out = set(fusion.OPTIMIZER_BUCKET_OPS)
+    out.update(t for t in fusion.FUSED_OP_TYPES
+               if "sparse" in t or any(o in t for o in
+                                       fusion.OPTIMIZER_BUCKET_OPS))
+    return frozenset(out)
+
+
+def is_training_only_op(op_type: str, op_role: Optional[str]) -> bool:
+    return (op_role in TRAINING_ONLY_ROLES
+            or op_type.endswith("_grad")
+            or op_type in training_only_op_types())
+
+
+def bucket_ladder(max_batch: int = DEFAULT_MAX_BATCH) -> Tuple[int, ...]:
+    """Powers-of-two padded batch sizes up to and including max_batch."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+def _pad_rows(arr: np.ndarray, rows: int) -> np.ndarray:
+    """Pad axis 0 to `rows` by repeating the last row: edge padding keeps
+    values in-distribution (no NaN from log(0)-style ops on zero rows) and
+    the mask is implicit — only the first n result rows are returned."""
+    n = arr.shape[0]
+    if n == rows:
+        return arr
+    pad = np.repeat(arr[-1:], rows - n, axis=0)
+    return np.concatenate([arr, pad], axis=0)
+
+
+class ServingEngine:
+    """AOT-compiled serving for one inference program.
+
+    `model` is either a `save_inference_model` directory (loaded into a
+    private scope) or an in-memory Program (pruned here; weights read from
+    `scope`/the global scope). `infer(feed)` is the synchronous
+    single-caller surface; `run_batch` is the batcher's hot path.
+    """
+
+    def __init__(self, model, feed_names: Optional[Sequence[str]] = None,
+                 fetch_names: Optional[Sequence[str]] = None, place=None,
+                 scope=None, max_batch: int = DEFAULT_MAX_BATCH,
+                 buckets: Optional[Sequence[int]] = None,
+                 cache_capacity: Optional[int] = None):
+        from .. import io as io_mod
+        from ..executor import (Executor, Scope, TPUPlace, scope_guard,
+                                global_scope)
+
+        self.place = place if place is not None else TPUPlace(0)
+        self._exe = Executor(self.place)
+        self.device = self._exe.device
+        self._lock = threading.RLock()
+        self._closed = False
+
+        if isinstance(model, str):
+            self._scope = Scope()
+            with scope_guard(self._scope):
+                program, loaded_feeds, fetch_targets = \
+                    io_mod.load_inference_model(model, self._exe)
+            feed_names = list(feed_names or loaded_feeds)
+            fetch_names = list(fetch_names
+                               or [v.name for v in fetch_targets])
+        else:
+            program = model
+            if not feed_names or not fetch_names:
+                raise ValueError(
+                    "ServingEngine(program) needs explicit feed_names and "
+                    "fetch_names (a model_dir carries them in __model__)")
+            feed_names = list(feed_names)
+            fetch_names = list(fetch_names)
+            program = io_mod._strip_training_ops(program) \
+                .prune(feed_names, fetch_names).clone(for_test=True)
+            self._scope = scope if scope is not None else global_scope()
+
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        self.program = program
+        self._label = telemetry.program_label(program)
+
+        self._admit(program, feed_names, fetch_names)
+
+        # ladder + cache geometry
+        if buckets is not None:
+            self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+            if not self.buckets or self.buckets[0] < 1:
+                raise ValueError(f"bad bucket ladder {buckets}")
+        else:
+            self.buckets = bucket_ladder(max_batch)
+        self.max_batch = self.buckets[-1]
+        self.cache_capacity = (int(cache_capacity) if cache_capacity
+                               else len(self.buckets))
+
+        # feed geometry from the program desc: leading dim must be the
+        # batch (-1) for the bucket ladder to apply
+        block = program.global_block()
+        self._feed_meta: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {}
+        for n in feed_names:
+            v = block.desc.var(n)
+            shape = tuple(int(d) for d in v.shape)
+            if not shape or shape[0] != -1:
+                raise ValueError(
+                    f"feed '{n}' has static shape {shape}; serving buckets "
+                    f"pad the leading batch dim, which must be -1")
+            self._feed_meta[n] = (shape, np.dtype(str(v.dtype)))
+
+        # compile the shared step fn once; per-bucket AOT executables are
+        # lowered from it on demand
+        self._compiled, self._state_names, self._persist_out = \
+            self._exe.prepare_serving(program, feed_names, fetch_names,
+                                      self._scope)
+
+        # device-resident weights: committed to the serving device when
+        # unmeshed; on a meshed program the first call distributes host
+        # arrays per in_shardings and the sharded results become resident
+        import jax
+        self._state: Dict[str, object] = {}
+        mesh = getattr(program, "_mesh", None)
+        for n in self._state_names:
+            v = self._scope.find_var(n)
+            arr = np.asarray(v.array() if hasattr(v, "array") else v)
+            self._state[n] = arr if mesh is not None \
+                else jax.device_put(arr, self.device)
+
+        self._executables: "collections.OrderedDict[int, object]" = \
+            collections.OrderedDict()
+        # python-side mirrors of the telemetry counters (tests + stats())
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.evictions = 0
+        self.bucket_runs: Dict[int, int] = {}
+
+    # --- admission ----------------------------------------------------------
+    def _admit(self, program, feed_names, fetch_names):
+        """PR 12 analyzer as an admission gate + training-op leak check."""
+        leaked = [
+            f"op[{i}] {op.type} (role={op.desc.attrs.get('op_role')})"
+            for i, op in enumerate(program.global_block().ops)
+            if is_training_only_op(op.type,
+                                   op.desc.attrs.get("op_role"))]
+        if leaked:
+            raise ValueError(
+                f"refusing to serve: training-only ops survived pruning: "
+                f"{leaked} — the inference fetch set likely includes a "
+                f"gradient or optimizer output")
+        # a gradient fetch doesn't leak ops — its producer was stripped,
+        # leaving the fetch uncomputable; refuse at admission instead of
+        # failing obscurely at the first bucket compile
+        block = program.global_block()
+        produced = {n for op in block.ops for n in op.output_arg_names}
+        for n in fetch_names:
+            v = block.desc.vars.get(n)
+            if (n not in produced and n not in feed_names
+                    and not (v is not None and v.persistable)):
+                raise ValueError(
+                    f"refusing to serve: fetch '{n}' is not computable "
+                    f"from the feeds — no op in the pruned program "
+                    f"produces it (a gradient/optimizer output is not an "
+                    f"inference fetch)")
+        from ..analysis import analyze_program
+        report = analyze_program(program, feeds=list(feed_names),
+                                 fetches=list(fetch_names))
+        if report.errors:
+            raise ProgramVerifyError(report.errors,
+                                     program_name="serving admission")
+        telemetry.log_event("serving_admit", program=self._label,
+                            ops=len(program.global_block().ops),
+                            warnings=len(report.warnings))
+
+    # --- bucket cache -------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _executable(self, bucket: int):
+        """AOT executable for one bucket — LRU cache with telemetry."""
+        import jax
+        from ..executor import _aval_of
+
+        ex = self._executables.get(bucket)
+        if ex is not None:
+            self._executables.move_to_end(bucket)
+            self.cache_hits += 1
+            telemetry.counter(
+                "serving_cache_hit_total",
+                "serving bucket-executable cache hits",
+                labels=("program", "bucket")).labels(
+                    program=self._label, bucket=str(bucket)).inc()
+            return ex
+        self.cache_misses += 1
+        telemetry.counter(
+            "serving_cache_miss_total",
+            "serving bucket-executable cache misses (AOT compiles)",
+            labels=("program", "bucket")).labels(
+                program=self._label, bucket=str(bucket)).inc()
+        feed_avals = {
+            n: jax.ShapeDtypeStruct((bucket,) + shape[1:], dtype)
+            for n, (shape, dtype) in self._feed_meta.items()}
+        state_avals = {n: _aval_of(v) for n, v in self._state.items()}
+        t0 = time.perf_counter()
+        ex = self._compiled.fn.lower(
+            feed_avals, state_avals, np.uint32(0)).compile()
+        dt = time.perf_counter() - t0
+        telemetry.histogram(
+            "serving_compile_seconds",
+            "AOT lower+compile wall seconds per bucket executable",
+            labels=("program", "bucket")).labels(
+                program=self._label, bucket=str(bucket)).observe(dt)
+        telemetry.log_event("serving_compile", program=self._label,
+                            bucket=bucket, seconds=dt)
+        self._executables[bucket] = ex
+        while len(self._executables) > self.cache_capacity:
+            evicted, _ = self._executables.popitem(last=False)
+            self.evictions += 1
+            telemetry.counter(
+                "serving_cache_evictions_total",
+                "bucket executables LRU-evicted",
+                labels=("program",)).labels(program=self._label).inc()
+            telemetry.log_event("serving_evict", program=self._label,
+                                bucket=evicted)
+        return ex
+
+    # --- execution ----------------------------------------------------------
+    def run_batch(self, feed: Dict[str, np.ndarray],
+                  valid_rows: Optional[int] = None) -> List[np.ndarray]:
+        """Execute one coalesced batch: pad to the smallest admissible
+        bucket, run its AOT executable, slice the valid rows back out.
+        The donated state round-trips: the returned new_state (same
+        buffers off-CPU) becomes the resident state for the next call."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        arrays = {}
+        n = None
+        for name in self.feed_names:
+            if name not in feed:
+                raise KeyError(f"missing feed '{name}'; engine feeds: "
+                               f"{self.feed_names}")
+            shape, dtype = self._feed_meta[name]
+            a = np.ascontiguousarray(feed[name], dtype=dtype)
+            if a.ndim != len(shape):
+                raise ValueError(
+                    f"feed '{name}' rank {a.ndim} != declared {len(shape)}")
+            if n is None:
+                n = a.shape[0]
+            elif a.shape[0] != n:
+                raise ValueError(
+                    f"feeds disagree on batch: '{name}' has {a.shape[0]} "
+                    f"rows, expected {n}")
+            arrays[name] = a
+        if n == 0:
+            raise ValueError("empty batch")
+        if n > self.max_batch:
+            raise ValueError(
+                f"batch {n} exceeds the largest bucket {self.max_batch}; "
+                f"split the request (infer() chunks automatically)")
+        rows = valid_rows if valid_rows is not None else n
+        bucket = self.bucket_for(n)
+        padded = {name: _pad_rows(a, bucket) for name, a in arrays.items()}
+        with self._lock:
+            ex = self._executable(bucket)
+            fetch, _lens, new_state = ex(padded, self._state,
+                                         np.uint32(0))
+            self._state = new_state
+        self.bucket_runs[bucket] = self.bucket_runs.get(bucket, 0) + 1
+        telemetry.counter(
+            "serving_bucket_runs_total",
+            "batches executed per bucket",
+            labels=("program", "bucket")).labels(
+                program=self._label, bucket=str(bucket)).inc()
+        return [np.asarray(f)[:rows] for f in fetch]
+
+    def infer(self, feed: Dict[str, object]) -> List[np.ndarray]:
+        """Synchronous single-caller inference. Dense feeds go through the
+        bucketed AOT path (chunked when larger than the top bucket);
+        LoDTensor feeds fall back to the classic executor on the same
+        pruned program."""
+        from ..executor import LoDTensor, scope_guard
+
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        if any(isinstance(feed.get(n), LoDTensor) and feed[n].lod
+               for n in self.feed_names):
+            telemetry.counter(
+                "serving_fallback_total",
+                "requests served by the non-AOT executor path",
+                labels=("program", "reason")).labels(
+                    program=self._label, reason="lod").inc()
+            with self._lock:
+                with scope_guard(self._scope):
+                    outs = self._exe.run(self.program, feed=dict(feed),
+                                         fetch_list=list(self.fetch_names),
+                                         scope=self._scope)
+            return [np.asarray(o) for o in outs]
+
+        arrays = {n: np.asarray(feed[n]) for n in self.feed_names}
+        n = arrays[self.feed_names[0]].shape[0]
+        if n <= self.max_batch:
+            return self.run_batch(arrays)
+        parts = []
+        for start in range(0, n, self.max_batch):
+            chunk = {k: v[start:start + self.max_batch]
+                     for k, v in arrays.items()}
+            parts.append(self.run_batch(chunk))
+        return [np.concatenate([p[i] for p in parts], axis=0)
+                for i in range(len(self.fetch_names))]
+
+    # --- lifecycle / introspection ------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        return {
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "evictions": self.evictions,
+            "bucket_runs": dict(self.bucket_runs),
+            "buckets": list(self.buckets),
+            "resident_state": len(self._state or ()),
+        }
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self):
+        """Destroy-handle semantics (C-API `paddle_tpu_machine_destroy`):
+        drop executables and resident device state; further calls raise."""
+        with self._lock:
+            self._executables.clear()
+            self._state = {}
+            self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

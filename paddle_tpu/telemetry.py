@@ -35,7 +35,7 @@ __all__ = [
     "enable_step_log", "disable_step_log", "step_log_path", "read_step_log",
     "export_chrome_trace", "default_buckets", "reset", "program_label",
     "jax_compile_seconds", "signature_of", "read_gauge", "read_series",
-    "read_histogram",
+    "read_histogram", "histogram_quantile",
 ]
 
 
@@ -310,6 +310,46 @@ def read_histogram(name: str, **labels) -> Optional[Dict[str, float]]:
             return None
         with _VALUES_LOCK:
             return {"sum": child.sum, "count": child.count}
+
+
+def histogram_quantile(name: str, q: float, **labels) -> Optional[float]:
+    """Quantile estimate of one histogram series from its cumulative bucket
+    counts (Prometheus histogram_quantile semantics: find the bucket whose
+    cumulative count crosses rank q*total, interpolate linearly inside it).
+    Accuracy is bounded by the bucket geometry — with default_buckets()'s
+    powers-of-4 ladder an estimate is within 4x of the true value, which is
+    enough to rank p50 against p99 and track trends. None when the series
+    does not exist or has no observations; same read-only contract as
+    read_histogram. The serving harness reads request-latency p50/p99 here."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    with _REG._lock:
+        fam = _REG._families.get(name)
+        if fam is None or fam.kind != "histogram":
+            return None
+        if set(labels) != set(fam.labelnames):
+            return None
+        child = fam._children.get(
+            tuple(str(labels[k]) for k in fam.labelnames))
+        if child is None:
+            return None
+        with _VALUES_LOCK:
+            counts = list(child.counts)
+            edges = list(child.buckets)
+            total = child.count
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts[:-1]):
+        prev = cum
+        cum += c
+        if cum >= rank and c > 0:
+            lo = edges[i - 1] if i > 0 else 0.0
+            hi = edges[i]
+            frac = min(max((rank - prev) / c, 0.0), 1.0)
+            return lo + (hi - lo) * frac
+    return edges[-1]  # rank fell in the +Inf tail: clamp to the last edge
 
 
 def _host_index() -> int:

@@ -158,6 +158,51 @@ def test_infer_lint_catches_orphan_and_overlap(monkeypatch):
                for _, m in problems), problems
 
 
+def test_serving_programs_clean():
+    """ISSUE 13 satellite: both shipped inference programs (transformer
+    logits, DLRM probabilities), after the ServingEngine's own
+    strip->prune->clone, contain only registered, non-training ops. A
+    grad/optimizer op leaking through prune means serving would mutate
+    weights per request; an unregistered op means the first serve
+    compile fails long after export."""
+    problems = _load_checker().check_serving_programs()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_serving_lint_catches_training_op(monkeypatch):
+    """Sanity: widening the training-only set so a benign forward op
+    (softmax) counts as training-only must trip the lint on the DLRM
+    program — proving the checker actually walks the pruned ops."""
+    from paddle_tpu import serving
+
+    checker = _load_checker()
+    orig = serving.is_training_only_op
+    monkeypatch.setattr(
+        serving, "is_training_only_op",
+        lambda op_type, op_role=None: (op_type == "softmax"
+                                       or orig(op_type, op_role)))
+    problems = checker.check_serving_programs()
+    assert any("training-only op 'softmax'" in m for _, m in problems), (
+        problems)
+
+
+def test_serving_lint_catches_unregistered_op(monkeypatch):
+    """Sanity: hiding a core op from the registry trips the
+    no-registered-lowering direction."""
+    from paddle_tpu.ops import registry
+
+    checker = _load_checker()
+    orig = registry.registered_ops
+
+    def without_softmax():
+        return [t for t in orig() if t != "softmax"]
+
+    monkeypatch.setattr(registry, "registered_ops", without_softmax)
+    problems = checker.check_serving_programs()
+    assert any("'softmax'" in m and "no registered lowering" in m
+               for _, m in problems), problems
+
+
 def test_cli_passes():
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     r = subprocess.run(

@@ -37,6 +37,13 @@ infer_shape, the jax.eval_shape fallback list, or the explicit
 DYNAMIC_SHAPE_OPS allowlist) — a newly registered op with no rule makes
 the analyzer silently blind to everything downstream of it. Orphan
 entries in the analysis tables are flagged in the converse direction.
+
+The serving lint (ISSUE 13 satellite) builds both shipped examples'
+inference programs (transformer logits, DLRM probabilities), applies the
+ServingEngine's own strip->prune->clone, and pins the result: every
+surviving op must have a registered lowering and none may be a
+training-only op (optimizer / `_grad` / fused-optimizer) — a leak here
+means prune kept a training subgraph and serving would mutate weights.
 """
 
 import sys
@@ -313,6 +320,75 @@ def check_infer_rules():
     return problems
 
 
+def check_serving_programs():
+    """[(where, message), ...] — pin the two shipped inference programs
+    (ISSUE 13) against the registry and the serving admission gate. Each
+    example's build_programs() declares its serving surface
+    (infer_feeds/infer_fetches); after the same strip->prune->clone the
+    ServingEngine applies, every surviving op must have a registered
+    lowering (an unregistered op only fails at first compile, long after
+    model export) and none may be training-only: an optimizer/grad op
+    leaking into a pruned program means prune kept a training subgraph
+    alive and every serve call would silently mutate the weights."""
+    import os
+
+    problems = []
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import importlib.util
+
+    from paddle_tpu import io as io_mod
+    from paddle_tpu import serving
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.ops import registry
+
+    registered = set(registry.registered_ops())
+    examples = {
+        "transformer_long_context": dict(seqlen=8, vocab=32),
+        "criteo_dlrm": dict(rows=64, dim=4, slots=3),
+    }
+    for name, tiny in sorted(examples.items()):
+        path = os.path.join(repo, "examples", "fluid",
+                            f"train_{name}.py")
+        spec = importlib.util.spec_from_file_location(
+            f"_lint_{name}", path)
+        mod = importlib.util.module_from_spec(spec)
+        try:
+            spec.loader.exec_module(mod)
+            with unique_name.guard():
+                progs = mod.build_programs(**tiny)
+        except Exception as e:  # noqa: BLE001 - a broken example IS a finding
+            problems.append((f"examples.{name}",
+                             f"build_programs failed: {e}"))
+            continue
+        feeds = progs.get("infer_feeds")
+        fetches = progs.get("infer_fetches")
+        if not feeds or not fetches:
+            problems.append((
+                f"examples.{name}",
+                "build_programs declares no infer_feeds/infer_fetches — "
+                "the example has no serving surface"))
+            continue
+        pruned = (io_mod._strip_training_ops(progs["main"])
+                  .prune(feeds, fetches).clone(for_test=True))
+        for op in pruned.global_block().ops:
+            role = op.desc.attrs.get("op_role")
+            if serving.is_training_only_op(op.type, role):
+                problems.append((
+                    f"examples.{name}",
+                    f"training-only op '{op.type}' (role={role!r}) "
+                    f"survived the inference prune — serving it would "
+                    f"mutate weights per request"))
+            if op.type not in registered:
+                problems.append((
+                    f"examples.{name}",
+                    f"pruned inference program contains '{op.type}' with "
+                    f"no registered lowering — first serve compile would "
+                    f"fail after export"))
+    return problems
+
+
 def main():
     problems = check_tables()
     for tname, name in problems:
@@ -332,7 +408,10 @@ def main():
     inferp = check_infer_rules()
     for where, msg in inferp:
         print(f"{where}: {msg}")
-    problems = problems + coll + jit + sparse + pallas + inferp
+    servp = check_serving_programs()
+    for where, msg in servp:
+        print(f"{where}: {msg}")
+    problems = problems + coll + jit + sparse + pallas + inferp + servp
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")

@@ -256,6 +256,98 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
     return batch * steps / dt, peak_hbm, perf, k
 
 
+def measure_serving(n_devices):
+    """SCALE_MODEL=serving (ISSUE 13): serve the criteo-style DLRM scorer
+    with its table fsdp-row-sharded over an n-device mesh, through
+    ServingEngine (per-bucket AOT executables) + DynamicBatcher under
+    concurrent clients, and return the serving-trajectory line for this
+    mesh size: p50_ms/p99_ms/qps/shed_fraction/bucket_hits/
+    goodput_fraction (+ the 2x overload phase) — the serve-side companion
+    to the training sweep's samples_per_sec."""
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as fluid
+    from paddle_tpu import executor as em
+    from paddle_tpu import telemetry
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.serving import DynamicBatcher, ServingEngine, run_load
+
+    rows = int(os.environ.get("SCALE_EMB_ROWS", "100000"))
+    dim = int(os.environ.get("SCALE_EMB_DIM", "64"))
+    slots = int(os.environ.get("SCALE_EMB_SLOTS", "26"))
+    clients = int(os.environ.get("SCALE_SERVE_CLIENTS", "4"))
+    requests = int(os.environ.get("SCALE_SERVE_REQUESTS", "16"))
+    max_batch = int(os.environ.get("SCALE_SERVE_MAX_BATCH", "16"))
+    delay_ms = float(os.environ.get("SCALE_SERVE_DELAY_MS", "3.0"))
+
+    with unique_name.guard():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            ids = fluid.layers.data(name="ids", shape=[slots],
+                                    dtype="int64")
+            emb = fluid.layers.embedding(
+                ids, size=[rows, dim], is_sparse=True,
+                param_attr=fluid.ParamAttr(name="emb_table"))
+            flat = fluid.layers.reshape(emb, shape=[-1, slots * dim])
+            h = fluid.layers.fc(input=flat, size=256, act="relu")
+            prob = fluid.layers.softmax(fluid.layers.fc(input=h, size=2))
+        if n_devices > 1:
+            from paddle_tpu.parallel import embedding as emb_mod
+            main_prog._mesh = Mesh(np.array(jax.devices()[:n_devices]),
+                                   ("fsdp",))
+            emb_mod.shard_table(main_prog, "emb_table", "fsdp")
+
+        scope = em.Scope()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        with em.scope_guard(scope):
+            exe.run(startup)
+        engine = ServingEngine(main_prog, feed_names=["ids"],
+                               fetch_names=[prob.name], scope=scope,
+                               max_batch=max_batch)
+        rng = np.random.default_rng(0)
+        choices = [1, 2, 3, max(1, max_batch // 4)]
+
+        def make_feed(ci, ri):
+            n = choices[(ci + ri) % len(choices)]
+            return {"ids": rng.integers(0, rows, (n, slots))
+                    .astype(np.int64)}
+
+        batcher = DynamicBatcher(engine, max_delay_ms=delay_ms,
+                                 max_queue_depth=32).start()
+        try:
+            # compile the buckets the load will hit outside the timed phase
+            for b in sorted({engine.bucket_for(c) for c in choices}):
+                engine.run_batch({"ids": rng.integers(0, rows, (b, slots))
+                                  .astype(np.int64)})
+            normal = run_load(batcher, make_feed, clients=clients,
+                              requests_per_client=requests, label="normal")
+            overload = run_load(batcher, make_feed, clients=2 * clients,
+                                requests_per_client=requests,
+                                deadline_ms=max(delay_ms * 8, 50.0),
+                                label="overload")
+        finally:
+            batcher.stop()
+        densify = telemetry.read_series("sparse_densify_fallback_total")
+        line = {
+            "devices": n_devices,
+            "p50_ms": normal["p50_ms"], "p99_ms": normal["p99_ms"],
+            "qps": round(normal["qps"], 1),
+            "shed_fraction": normal["shed_fraction"],
+            "bucket_hits": normal["bucket_hits"],
+            "goodput_fraction": normal["goodput_fraction"],
+            "overload": {k: overload[k] for k in
+                         ("p50_ms", "p99_ms", "qps", "shed_fraction",
+                          "bucket_hits", "goodput_fraction")},
+            "table_rows": rows, "max_batch": max_batch,
+            "compile_cache": {"hits": engine.cache_hits,
+                              "misses": engine.cache_misses},
+            "densify_fallbacks": sum(densify.values()),
+        }
+        engine.close()
+    return line
+
+
 def _analyze_fields(main):
     """analyze_errors / analyze_warnings for the per-mesh JSON line (same
     contract as bench.py): one static-verifier pass over the measured
@@ -389,6 +481,22 @@ def main(argv):
         raise SystemExit(
             f"requested mesh sizes {too_big} exceed the "
             f"{len(jax.devices())} available devices")
+    if os.environ.get("SCALE_MODEL") == "serving":
+        # serving sweep: one line per mesh size carrying the serving
+        # trajectory keys instead of samples_per_sec
+        last = None
+        for n in sizes:
+            line = measure_serving(n)
+            last = line
+            print(json.dumps(line), flush=True)
+        if last is not None:
+            print(json.dumps({
+                "metric": "serving_qps", "value": last["qps"],
+                "unit": "requests/sec", "devices": last["devices"],
+                "p99_ms": last["p99_ms"],
+                "goodput_fraction": last["overload"]["goodput_fraction"],
+            }))
+        return
     results = {}
     for n in sizes:
         sps, peak_hbm, perf, k = measure(n, steps_per_call=steps_per_call)
