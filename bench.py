@@ -1062,27 +1062,46 @@ def main_ring_attention():
 
 
 def main_embedding():
-    """Criteo-DLRM-style sparse embedding family (ISSUE 10): one shared
-    [ROWS, DIM] table looked up by SLOTS categorical features per example,
-    row-sharded over an fsdp mesh of every visible device, trained with
-    Adam through the SelectedRows scatter-apply path (no dense [ROWS, DIM]
-    gradient or moment update ever materializes). The JSON line reports
-    rows_touched_per_sec — the sparse-path throughput unit: ids presented
-    to the table per second — next to the table geometry, whether
-    scatter-apply was live, the densify-fallback count (must stay 0), and
-    per-shard HBM table/opt-state bytes (on an 8-device mesh per-shard is
-    total/8). No AMP: the table and its moments stay f32."""
+    """Criteo-DLRM-style sparse embedding family (ISSUE 10 + 14): one
+    shared [ROWS, DIM] table looked up by SLOTS categorical features per
+    example, trained with Adam through the SelectedRows scatter-apply
+    path (no dense [ROWS, DIM] gradient or moment update ever
+    materializes). The JSON line reports rows_touched_per_sec — the
+    sparse-path throughput unit: ids presented to the table per second —
+    next to the table geometry, whether scatter-apply was live, the
+    densify-fallback count (must stay 0), and HBM table/opt-state bytes.
+    No AMP: the table and its moments stay f32.
+
+    Default config: table row-sharded over an fsdp mesh of every visible
+    device (the cache columns emit null). BENCH_EMB_BUDGET=<MB> instead
+    runs the beyond-HBM hot-row cache (ISSUE 14): the table stays
+    UNSHARDED (cache and row-sharding are mutually exclusive per table),
+    only a budget-sized slab is device-resident, ids draw from a zipf
+    law (skew BENCH_EMB_ZIPF, default 1.3 — the criteo-like regime where
+    a small hot set covers most lookups), training runs fused
+    BENCH_EMB_WINDOW-step windows through DoubleBufferedFeeder with the
+    NEXT window's rows prefetched behind the in-flight window's compute,
+    and three more columns report steady-state (post-warmup) cache
+    behavior: cache_hit_rate, prefetch_overlap_fraction, and
+    flush_bytes_per_step. A rows>budget table trains fine — that is the
+    point — and densify_fallbacks must still be 0: the cache feeds the
+    same scatter-apply kernels, just slab-indexed."""
     import jax
     import paddle_tpu as fluid
     from paddle_tpu import telemetry
     from paddle_tpu.ops import sparse_ops
+    from paddle_tpu.parallel import emb_cache as emb_cache_mod
     from paddle_tpu.parallel import embedding as emb_mod
     from paddle_tpu.parallel.mesh import make_mesh
+    from paddle_tpu.reader.pipeline import DoubleBufferedFeeder
 
     bsz = int(BATCH) if BATCH else 256
     rows = int(os.environ.get("BENCH_EMB_ROWS", "1000000"))
     dim = int(os.environ.get("BENCH_EMB_DIM", "64"))
     slots = int(os.environ.get("BENCH_EMB_SLOTS", "26"))
+    budget_mb = os.environ.get("BENCH_EMB_BUDGET")   # MB; enables cache
+    zipf_a = float(os.environ.get("BENCH_EMB_ZIPF", "1.3"))
+    k_window = int(os.environ.get("BENCH_EMB_WINDOW", "8"))
     devs = jax.devices()
 
     main_prog, startup = fluid.Program(), fluid.Program()
@@ -1100,45 +1119,126 @@ def main_embedding():
             fluid.layers.softmax_with_cross_entropy(logits, label))
         fluid.optimizer.Adam(learning_rate=1e-3).minimize(
             loss, startup_program=startup)
-    main_prog._mesh = make_mesh((len(devs),), ("fsdp",))
-    emb_mod.shard_table(main_prog, "emb_table", "fsdp")
+    if budget_mb is None:
+        main_prog._mesh = make_mesh((len(devs),), ("fsdp",))
+        emb_mod.shard_table(main_prog, "emb_table", "fsdp")
 
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup)
     rng = np.random.default_rng(0)
-    ids_np = rng.integers(0, rows, (bsz, slots)).astype(np.int64)
     lab_np = rng.integers(0, 2, (bsz, 1)).astype(np.int64)
-    feed = {"ids": jax.device_put(ids_np), "label": jax.device_put(lab_np)}
 
-    def step():
-        out, = exe.run(main_prog, feed=feed, fetch_list=[loss],
-                       return_numpy=False)
-        return out
+    def draw_ids():
+        if zipf_a > 1.0:
+            z = rng.zipf(zipf_a, (bsz, slots)).astype(np.int64) - 1
+            return np.minimum(z, rows - 1)
+        return rng.integers(0, rows, (bsz, slots)).astype(np.int64)
+
+    cache = None
+    errors = []
+    if budget_mb is not None:
+        cache = emb_cache_mod.enable(
+            main_prog, budget_bytes=int(float(budget_mb) * (1 << 20)))
+        if cache is None:
+            raise RuntimeError(
+                f"BENCH_EMB_BUDGET={budget_mb}MB covers the whole "
+                f"{rows}x{dim} table (or PADDLE_TPU_EMB_CACHE=0) — "
+                f"nothing beyond-HBM to measure")
+        sparse_names = cache.feed_id_names()
+
+        def batches():
+            while True:
+                yield {"ids": draw_ids(), "label": lab_np}
+
+        feeder = DoubleBufferedFeeder(batches, window_prefetch=2)
+        pending = {"win": None, "handle": None}
+        calls = [0]
+        steady = {}        # stats snapshot at the warmup->timed boundary
+
+        def step():
+            # overlapped driver: dispatch window i, pull + prefetch
+            # window i+1 while i computes, then block on i's loss
+            if pending["win"] is None:
+                pending["win"], _ = feeder.next_window(
+                    k_window, device=exe.device, sparse_slots=sparse_names)
+            out = exe.run_steps(
+                main_prog, feed_window=pending["win"], fetch_list=[loss],
+                fetch_mode="last", return_numpy=False)
+            nwin, nuniq = feeder.next_window(
+                k_window, device=exe.device, sparse_slots=sparse_names)
+            handle = cache.prefetch(nuniq)
+            val = out[0]
+            np.asarray(val)            # block: compute hides the prefetch
+            handle.wait()
+            pending["win"] = nwin
+            calls[0] += 1
+            if calls[0] == max(WARMUP, 1):    # steady-state boundary
+                steady.update(cache.stats(), calls=calls[0])
+            return val
+
+        rows_per_call = bsz * slots * k_window
+    else:
+        ids_np = draw_ids()
+        feed = {"ids": jax.device_put(ids_np),
+                "label": jax.device_put(lab_np)}
+
+        def step():
+            out, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                           return_numpy=False)
+            return out
+
+        rows_per_call = bsz * slots
 
     _PERF_STEP[0] = step
     _ANALYZE_PROG[0] = main_prog
-    errors = []
     dt, done = _timed_loop(step, WARMUP, STEPS, errors)
-    s_step = dt / done
-    rows_touched = bsz * slots           # ids presented per step, pre-merge
+    s_call = dt / done
+
+    cache_hit_rate = overlap_frac = flush_per_step = None
+    if cache is not None:
+        s = cache.stats()
+        base = steady or {"hits": 0, "misses": 0, "flush_bytes": 0,
+                          "calls": 0}
+        d_hit = s["hits"] - base["hits"]
+        d_miss = s["misses"] - base["misses"]
+        d_steps = max((calls[0] - base.get("calls", 0)) * k_window, 1)
+        cache_hit_rate = round(d_hit / max(d_hit + d_miss, 1), 4)
+        overlap_frac = round(s["overlap_fraction"], 4)
+        flush_per_step = round(
+            (s["flush_bytes"] - base["flush_bytes"]) / d_steps, 1)
+
     per = emb_mod.per_shard_table_bytes(main_prog)
-    t = per["tables"]["emb_table"]
+    t = per["tables"].get("emb_table") if per.get("tables") else None
     densify = telemetry.read_series("sparse_densify_fallback_total")
+    cache_spec = (next(iter(cache.tables().values()))
+                  if cache is not None else None)
     _emit({
         "metric": "embedding_rows_touched_per_sec",
-        "value": round(rows_touched / s_step, 1),
+        "value": round(rows_per_call / s_call, 1),
         "unit": "rows/sec",
         "vs_baseline": None,   # no reference-published criteo anchor
-        "examples_per_sec": round(bsz / s_step, 1),
+        "examples_per_sec": round(
+            bsz * (k_window if cache is not None else 1) / s_call, 1),
         "batch": bsz, "table_rows": rows, "emb_dim": dim, "slots": slots,
+        "zipf_skew": zipf_a if zipf_a > 1.0 else None,
         "sparse_apply": sparse_ops.sparse_apply_enabled(),
-        "fsdp_devices": len(devs),
-        "table_bytes": t["bytes"],
-        "table_bytes_per_shard": t["per_shard_bytes"],
-        "opt_state_bytes_per_shard": t["opt_state_per_shard_bytes"],
+        "fsdp_devices": len(devs) if budget_mb is None else None,
+        "table_bytes": t["bytes"] if t else rows * dim * 4,
+        "table_bytes_per_shard": t["per_shard_bytes"] if t else None,
+        "opt_state_bytes_per_shard":
+            t["opt_state_per_shard_bytes"] if t else None,
+        "cache_rows": cache_spec.cache_rows if cache_spec else None,
+        "cache_hit_rate": cache_hit_rate,
+        "prefetch_overlap_fraction": overlap_frac,
+        "flush_bytes_per_step": flush_per_step,
         "densify_fallbacks": sum(densify.values()),
         "steps_timed": done,
     }, errors)
+    if cache is not None:
+        # only AFTER _emit: _perf_fields re-runs step() for roofline
+        # attribution, and step() pulls from the feeder — stopping it
+        # earlier deadlocks that capture on next_window
+        feeder.stop()
 
 
 def main_serving():

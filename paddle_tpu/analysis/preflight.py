@@ -249,8 +249,61 @@ def _check_plans(pctx):
                   f"{sum(len(b.grads) for b in plan.buckets)} gradient(s)")
 
 
+def _check_emb_cache(pctx):
+    """Beyond-HBM hot-row cache sizing: the per-step touched-row bound
+    for a cached table is the total id count its lookups can feed in one
+    step (every id distinct in the worst case). When that bound exceeds
+    cache_rows, steady-state steps evict rows they staged moments ago —
+    and a fused run_steps window, whose whole-id union must be resident
+    at once, can fail outright. Static shapes only; -1 dims probe as
+    _PROBE_BATCH, so the bound scales with the real batch at runtime."""
+    program = pctx.program
+    from ..parallel import emb_cache as emb_cache_mod
+
+    sized = {}  # table -> cache_rows (active cache wins over requests)
+    cache = emb_cache_mod.active_cache(program)
+    if cache is not None:
+        for t in cache.tables().values():
+            sized[t.name] = t.cache_rows
+    for name, rows in emb_cache_mod.requested_rows(program).items():
+        sized.setdefault(name, int(rows))
+    if not sized:
+        return
+
+    block = pctx.block
+    bound = {}     # table -> summed worst-case ids per step
+    first_op = {}  # table -> op index of its first lookup
+    for i, op in enumerate(pctx.ops):
+        if op.type != "lookup_table":
+            continue
+        wname = (op.input("W") or [None])[0]
+        ids = (op.input("Ids") or [None])[0]
+        if wname not in sized or not ids or not block.has_var(ids):
+            continue
+        shape = tuple(block.var(ids).shape or ())
+        n = 1
+        for d in shape:
+            n *= _PROBE_BATCH if int(d) == -1 else int(d)
+        bound[wname] = bound.get(wname, 0) + n
+        first_op.setdefault(wname, i)
+    for wname, n in sorted(bound.items()):
+        if n <= sized[wname]:
+            continue
+        pctx.emit(
+            "warning", "emb-cache-thrash",
+            f"cached table '{wname}' can touch up to {n} unique rows "
+            f"per step (batch probed as {_PROBE_BATCH} for -1 dims) but "
+            f"cache_rows={sized[wname]}: steady-state steps will evict "
+            f"rows staged the same step, and a fused window's id union "
+            f"may not fit the slab at all",
+            op_index=first_op[wname], var=wname,
+            hint="raise cache_rows (or the enable() budget) above the "
+                 "per-step touched-row bound, or lower the batch size")
+
+
 def run(pctx):
     _check_pallas_convs(pctx)
     _check_shardings(pctx)
     _check_layout(pctx)
     _check_plans(pctx)
+    _check_emb_cache(pctx)

@@ -546,6 +546,18 @@ def _finite_all(leaves):
         jnp.asarray(True))
 
 
+def _accepts_sparse_slots(reader) -> bool:
+    """Whether a run_steps reader's next_window takes the emb_cache
+    sparse_slots hook (reader.pipeline.DoubleBufferedFeeder does;
+    user-supplied readers may predate it)."""
+    import inspect
+    try:
+        return "sparse_slots" in inspect.signature(
+            reader.next_window).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 class _WindowUnsupported(Exception):
     """Raised at trace time when a program feature (sequence/LoD fetches,
     shape-changing state) cannot ride through the lax.scan window; the
@@ -645,13 +657,24 @@ class Executor:
             raise ValueError(f"fetch_mode must be last|stack|mean, "
                              f"got {fetch_mode!r}")
         scope = scope if scope is not None else global_scope()
+        emb_cache = getattr(program, "_emb_cache", None)
         if reader is not None:
             if feed_window is not None:
                 raise ValueError("pass feed_window or reader, not both")
             if steps is None:
                 raise ValueError("reader windows need an explicit steps=K")
-            # may raise StopIteration at end of pass — the drain signal
-            feed_window = reader.next_window(steps, device=self.device)
+            # may raise StopIteration at end of pass — the drain signal.
+            # With a hot-row cache active, ask the feeder to keep the
+            # cached-table id slots host-side and hand back their
+            # unique-id union (sparse_slots) — the ids remap to cache
+            # slots below, so device_put-ing the raw ids would waste the
+            # transfer and force a sync for the remap.
+            if emb_cache is not None and _accepts_sparse_slots(reader):
+                feed_window, _uniq = reader.next_window(
+                    steps, device=self.device,
+                    sparse_slots=emb_cache.feed_id_names())
+            else:
+                feed_window = reader.next_window(steps, device=self.device)
         if feed_window is None:
             raise ValueError("run_steps needs feed_window= or reader=")
         stacked, per_step, steps, lod_reason = self._normalize_window(
@@ -684,9 +707,19 @@ class Executor:
         if reason is None:
             _maybe_warn_cpu_scan_conv(self.device, program, steps)
             try:
+                # emb_cache: remap the WHOLE window's ids to cache slots
+                # in one residency transaction (every scanned step runs
+                # against the same slab, so the union must be resident
+                # at once). Done only on the window path: the per-step
+                # fallback below re-derives feeds from the raw `stacked`
+                # and each run() call remaps its own step — remapping
+                # twice would read slot ids as global row ids.
+                win_stacked = (emb_cache.prepare_feed(stacked)
+                               if emb_cache is not None else stacked)
                 return self._run_steps_window(
-                    program, stacked, steps, fetch_list, scope, return_numpy,
-                    fetch_mode, use_program_cache, prog_label, place_label)
+                    program, win_stacked, steps, fetch_list, scope,
+                    return_numpy, fetch_mode, use_program_cache,
+                    prog_label, place_label)
             except _WindowUnsupported as e:
                 reason = "trace_unsupported"
                 vlog(1, f"run_steps window unsupported, falling back: {e}")
@@ -1016,6 +1049,13 @@ class Executor:
                 continue
             batch_vals = reader.next_batch(self.device)
             feed.update(dict(zip(names, batch_vals)))
+        # beyond-HBM hot-row cache (parallel/emb_cache.py): make the fed
+        # ids of cached tables resident and remap them to cache-slot
+        # indices, so lookup_table and the scatter-apply optimizers run
+        # against the fixed-size device slab with static shapes
+        emb_cache = getattr(program, "_emb_cache", None)
+        if emb_cache is not None:
+            feed = emb_cache.prepare_feed(feed)
         fetch_list = list(fetch_list or [])
         scope = scope if scope is not None else global_scope()
         fetch_names = [v.name if isinstance(v, Variable) else str(v)

@@ -48,10 +48,22 @@ def save_vars(executor: Executor, dirname: str, main_program: Optional[Program]
                 if predicate is None or predicate(v)]
     os.makedirs(dirname, exist_ok=True)
     scope = global_scope()
+    # beyond-HBM cached tables: the scope holds only the [cache_rows, dim]
+    # hot-row slab — flush dirty slots to the host-DRAM authoritative
+    # store FIRST (crash-consistency barrier: once flushed, the host slab
+    # is complete even if the process dies mid-save), then checkpoint the
+    # full host table in the slab's place.
+    emb_cache = getattr(main_program, "_emb_cache", None)
+    if emb_cache is not None:
+        emb_cache.flush()
     combine = {}
     total_bytes = n_saved = 0
     for v in vars:
         val = scope.find_var(v.name)
+        if emb_cache is not None:
+            host = emb_cache.host_value(v.name)
+            if host is not None:
+                val = host
         if val is None:
             continue
         lod = None
@@ -124,6 +136,17 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
                 if predicate is None or predicate(v)]
     scope = global_scope()
     total_bytes = n_loaded = 0
+    # cached tables restore into the host-DRAM authoritative slab (the
+    # checkpoint holds the FULL table) and invalidate residency — the
+    # scope keeps the cache slab, whose slots re-stage on first touch
+    emb_cache = getattr(main_program, "_emb_cache", None)
+
+    def _restore(name, arr, lod):
+        if emb_cache is not None and emb_cache.load_host(
+                name, np.asarray(arr)):
+            return
+        scope.set_var(name, LoDTensor(arr, lod) if lod else arr)
+
     if load_file_name is not None:
         with open(os.path.join(dirname, load_file_name), "rb") as f:
             blob = pickle.load(f)
@@ -132,7 +155,7 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
                 arr, lod = blob[v.name]
                 total_bytes += np.asarray(arr).nbytes
                 n_loaded += 1
-                scope.set_var(v.name, LoDTensor(arr, lod) if lod else arr)
+                _restore(v.name, arr, lod)
         _record_checkpoint("load", dirname, total_bytes, n_loaded,
                            time.perf_counter() - t0)
         return
@@ -143,7 +166,7 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         arr, lod = _load_one(path)
         total_bytes += np.asarray(arr).nbytes
         n_loaded += 1
-        scope.set_var(v.name, LoDTensor(arr, lod) if lod else arr)
+        _restore(v.name, arr, lod)
     _record_checkpoint("load", dirname, total_bytes, n_loaded,
                        time.perf_counter() - t0)
 
@@ -179,7 +202,11 @@ def get_inference_program(target_vars, main_program=None):
         target_vars = [target_vars]
     forward = _strip_training_ops(main_program)
     pruned = forward.prune([], [t.name for t in target_vars])
-    return pruned.clone(for_test=True)
+    out = pruned.clone(for_test=True)
+    emb_cache = getattr(main_program, "_emb_cache", None)
+    if emb_cache is not None:     # shares the source scope's cache slabs
+        out._emb_cache = emb_cache
+    return out
 
 
 def save_inference_model(dirname: str, feeded_var_names: List[str],
@@ -198,6 +225,14 @@ def save_inference_model(dirname: str, feeded_var_names: List[str],
     pruned = _strip_training_ops(main_program).prune(
         feeded_var_names, [t.name for t in target_vars])
     inference_program = pruned.clone(for_test=True)
+    # a hot-row emb cache lives on the PROGRAM but its slabs live in the
+    # SCOPE, which the pruned clone shares — without propagating it, the
+    # save below would checkpoint the [cache_rows, dim] device slab as if
+    # it were the full table (and running the clone would feed global ids
+    # into slot-indexed lookups)
+    emb_cache = getattr(main_program, "_emb_cache", None)
+    if emb_cache is not None:
+        inference_program._emb_cache = emb_cache
     # feeds the targets do not depend on were pruned away; drop them from
     # the recorded feed list so inference callers need not supply them
     # (e.g. the label input of a training program)

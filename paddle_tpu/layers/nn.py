@@ -89,7 +89,7 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32",
-              shard_axis=None):
+              shard_axis=None, cache_rows=None):
     """Embedding lookup (reference nn.py:188).
 
     is_sparse=True keeps the gradient a SelectedRows value end-to-end:
@@ -102,7 +102,14 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     over `shard_axis` (default PADDLE_TPU_EMB_SHARD_AXIS, "fsdp") and
     lookups mod-shard-route ids under pd.coll.emb_lookup. Pass
     shard_axis explicitly (an axis name or tuple) to shard without the
-    is_distributed flag."""
+    is_distributed flag.
+
+    cache_rows=N opts the table into the beyond-HBM hot-row cache
+    (parallel/emb_cache.py): only N rows live on device, the full table
+    stays authoritative in host DRAM, and ids remap to cache slots at
+    feed time. The request is recorded here; emb_cache.enable(program)
+    activates it after the startup program ran (requires is_sparse=True
+    and is mutually exclusive with sharding/padding_idx)."""
     helper = LayerHelper("embedding", param_attr=param_attr)
     w = helper.create_parameter(attr=helper.param_attr, shape=size,
                                 dtype=dtype, is_bias=False)
@@ -116,6 +123,10 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     if shard_axis is not None or is_distributed:
         from ..parallel import embedding as embedding_mod
         embedding_mod.shard_table(helper.main_program, w.name, shard_axis)
+    if cache_rows is not None:
+        from ..parallel import emb_cache as emb_cache_mod
+        emb_cache_mod.request_cache(helper.main_program, w.name,
+                                    cache_rows)
     return tmp
 
 

@@ -785,6 +785,14 @@ class HeadroomModel:
             return 0
         return int((budget_bytes - self.fixed_bytes) // self.per_item_bytes)
 
+    def headroom(self, budget_bytes: int, batch: int) -> int:
+        """Device bytes left under `budget_bytes` after the predicted
+        peak at `batch` — what's genuinely free for extra resident state.
+        The beyond-HBM embedding cache sizes its hot-row slab from this
+        (emb_cache.budget_from_headroom subtracts the window feed buffer
+        on top). Clamped at 0: an over-budget batch has no headroom."""
+        return max(0, int(budget_bytes) - self.predict(batch))
+
     def to_dict(self) -> Dict[str, Any]:
         return {"fixed_bytes": int(self.fixed_bytes),
                 "per_item_bytes": round(self.per_item_bytes, 2),

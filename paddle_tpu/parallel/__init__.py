@@ -13,6 +13,7 @@ from . import ring_attention
 from . import embedding
 from .embedding import (SpecLayout, shard_table, shard_embeddings,
                         per_shard_table_bytes)
+from . import emb_cache
 from . import pipeline
 from .pipeline import gpipe
 from . import program_pipeline

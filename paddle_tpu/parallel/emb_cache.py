@@ -1,0 +1,825 @@
+"""Beyond-HBM embedding tables: device hot-row cache over a host-DRAM
+authoritative store (ISSUE 14 tentpole).
+
+The reference framework keeps recommender-scale tables on parameter
+servers and caches hot rows near the worker (distributed lookup_table,
+PAPER.md pserver machinery). The TPU-native translation: the
+*authoritative* table lives in host DRAM as a numpy slab per table (and
+per optimizer accumulator), while the traced program sees only a
+fixed-size device-resident **cache slab** of `cache_rows` hot rows.
+Feed-time id→slot remapping keeps every traced shape static as the
+cache churns, so:
+
+  * `lookup_table` does its normal static-shape `jnp.take` — against
+    the [cache_rows, dim] slab with slot indices instead of row ids;
+  * the PR 10 scatter-apply optimizers (`sgd/momentum/adam`
+    SelectedRows kernels, ops/sparse_ops.py) run unmodified: the
+    gradient's rows are already slot indices, and `.at[rows].set(...,
+    mode="drop")` scatters into the slab.
+
+Touched-row numerics are bitwise (sgd/momentum) / tolerance (lazy
+adam) equal to the all-HBM path: remapping is elementwise, so
+`merge_selected_rows`'s per-id segment sums see the same addends in
+the same order, and the `*_dense` update math runs on identical row
+values (tests/test_emb_cache.py pins this end to end, across a
+checkpoint save/restore).
+
+Residency protocol (`EmbCache`):
+
+  * `prepare_feed(feed)` — make every id of the feed resident
+    (`_ensure`), remap ids→slots, pin the window's slots against
+    eviction while the dispatched step is in flight, and mark them
+    dirty (the optimizer will scatter into them).
+  * `prefetch(uniq)` — the overlapped half: a background thread
+    resolves window i+1's unique-id union (from
+    `DoubleBufferedFeeder.next_window(..., sparse_slots=[...])`)
+    against the id→slot map and stages only the missing rows (plus
+    accumulator rows) into victim slots while window i computes.
+    Victims are chosen LRU-with-frequency-tiebreak; dirty victims
+    flush back to the host slab off the critical path.
+  * `flush()` — write every dirty slot back to host DRAM. io.py calls
+    this before checkpoint save and substitutes the host slab for the
+    cache slab, so a crash after save never loses touched rows
+    (crash-consistency: host DRAM is authoritative, the checkpoint is
+    taken from it after the flush barrier).
+
+Gating: `PADDLE_TPU_EMB_CACHE=0` kill-switch; per-table opt-in via
+`layers.embedding(..., cache_rows=N)` or `enable(program,
+budget_bytes=...)` (budget sized e.g. from `memory.HeadroomModel`
+headroom minus the window feed buffer — `budget_from_headroom`).
+
+Telemetry: `emb_cache_hit_rate{table}`,
+`emb_cache_prefetch_overlap_fraction`, `emb_cache_flush_bytes_total`,
+`emb_cache_evictions_total{policy}` plus hit/miss counters.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "CACHE_AWARE_OPS", "cache_enabled", "request_cache", "requested_rows",
+    "rows_for_budget", "budget_from_headroom", "enable", "enable_serving",
+    "active_cache", "EmbCache",
+]
+
+#: Ops allowed to reference a cached table. Everything here either
+#: gathers with the (already slot-remapped) feed ids or scatters a
+#: SelectedRows update whose rows ARE those slot indices — no op in this
+#: set ever interprets a table index it did not receive from the feed,
+#: so the remap is complete. tools/check_registry.check_emb_cache pins
+#: this set against sparse_ops.SPARSE_APPLY_OPS and
+#: executor._SPARSE_AWARE_OPS; enable() refuses a table referenced by
+#: any op outside it (that op would index with global row ids and read
+#: garbage slots).
+def _cache_aware_ops() -> frozenset:
+    from ..ops import sparse_ops
+    return frozenset(
+        {"lookup_table", "lookup_table_grad"}
+        | set(sparse_ops.SPARSE_APPLY_OPS)
+        | {"fused_sparse_" + t for t in sparse_ops.SPARSE_APPLY_OPS})
+
+
+CACHE_AWARE_OPS: frozenset = _cache_aware_ops()
+
+_EVICT_POLICY = "lru_freq"
+
+
+def cache_enabled() -> bool:
+    """PADDLE_TPU_EMB_CACHE kill-switch (default on; the feature is
+    already opt-in per table, the env gates it off for bisection)."""
+    return os.environ.get("PADDLE_TPU_EMB_CACHE", "1") != "0"
+
+
+def request_cache(program, param_name: str, cache_rows: int):
+    """Record a per-table cache request (layers.embedding(cache_rows=N)
+    routes here); `enable(program)` activates every recorded table."""
+    req = getattr(program, "_emb_cache_rows", None)
+    if req is None:
+        req = program._emb_cache_rows = {}
+    req[param_name] = int(cache_rows)
+    return program
+
+
+def requested_rows(program) -> Dict[str, int]:
+    return dict(getattr(program, "_emb_cache_rows", None) or {})
+
+
+def active_cache(program) -> Optional["EmbCache"]:
+    """The program's live EmbCache, or None (also None when the
+    kill-switch is set after enable — remapping garbage is worse than
+    serving the slab as-is, so the gate is read at enable time only)."""
+    return getattr(program, "_emb_cache", None)
+
+
+def rows_for_budget(budget_bytes: int, dim: int, itemsize: int,
+                    n_state: int) -> int:
+    """cache_rows affordable under `budget_bytes` of device memory: one
+    row costs dim*itemsize for the param plus the same for every cached
+    accumulator slab (adam: x3)."""
+    row_bytes = max(1, int(dim) * int(itemsize) * max(1, int(n_state)))
+    return max(0, int(budget_bytes) // row_bytes)
+
+
+def budget_from_headroom(model, batch: int, limit_bytes: Optional[int] = None,
+                         window_feed_bytes: int = 0) -> int:
+    """Device bytes left for cache slabs: HBM limit minus the
+    HeadroomModel's predicted peak at `batch` minus the window feed
+    buffer (run_steps stages K batches on device). The ISSUE-mandated
+    sizing hook: fit the model from two static analyses, then size the
+    cache from what's genuinely left."""
+    from .. import memory as memory_mod
+    limit = int(limit_bytes) if limit_bytes else memory_mod.default_budget()
+    return int(model.headroom(limit, batch)) - int(window_feed_bytes)
+
+
+class _CachedTable:
+    """Residency state for one table: host-DRAM authoritative slabs for
+    the param + each row-shaped optimizer accumulator, the id→slot /
+    slot→id maps, and the LRU-with-frequency eviction bookkeeping."""
+
+    def __init__(self, name: str, rows: int, dim: int, cache_rows: int,
+                 state_names: Sequence[str], ids_inputs: Sequence[str]):
+        self.name = name
+        self.rows = int(rows)
+        self.dim = int(dim)
+        self.cache_rows = int(cache_rows)
+        self.state_names = list(state_names)     # param first
+        self.ids_inputs = list(ids_inputs)       # feed var names
+        self.host: Dict[str, np.ndarray] = {}
+        # id→slot is a flat int32 map (8..4 bytes/row host DRAM — dwarfed
+        # by the dim*4-byte slabs it indexes); -1 = not resident
+        self.id2slot = np.full(self.rows, -1, dtype=np.int32)
+        self.slot2id = np.full(self.cache_rows, -1, dtype=np.int64)
+        # ids ever counted by prepare_feed: splits misses into compulsory
+        # (first touch — no policy could have avoided it) vs capacity
+        # (the row was here once and got evicted) so hit-rate gates can
+        # judge the eviction policy, not the workload's novelty rate
+        self.ever = np.zeros(self.rows, dtype=bool)
+        self.freq = np.zeros(self.cache_rows, dtype=np.int64)
+        self.last_used = np.zeros(self.cache_rows, dtype=np.int64)
+        self.dirty = np.zeros(self.cache_rows, dtype=bool)
+        self.tick = 0
+        # NOTE: no pin on the in-flight window's slots. Evicting one is
+        # safe — the dirty flush reads the slab through get_state, which
+        # holds the post-update array; np.asarray on it blocks until the
+        # dispatched window lands, so the flushed values are current.
+        # (uniq ids, ids the prefetch staged as misses) of the last
+        # prefetch — the consuming prepare_feed counts those as misses
+        # rather than re-deriving them (they are resident by then)
+        self.prefetch_pending = None
+        # occurrence-weighted (per lookup, not per unique id): the zipf
+        # head's mass is the whole point of a hot-row cache, and a
+        # unique-id denominator would erase it
+        self.hits = 0
+        self.misses = 0
+        self.miss_compulsory = 0
+        self.evictions = 0
+
+
+class _PrefetchHandle:
+    """Join handle for one background prefetch. `wait()` measures how
+    much of the prefetch's wall time was hidden behind the caller's
+    compute: the fraction of [start, end] that elapsed before the
+    caller reached wait() (the caller dispatched the window first, so
+    time before wait-entry ran under the in-flight step)."""
+
+    def __init__(self, cache: "EmbCache", work: Callable[[], None]):
+        self._cache = cache
+        self._t0 = 0.0
+        self._t1 = 0.0
+        self._err: Optional[BaseException] = None
+
+        def run():
+            self._t0 = time.perf_counter()
+            try:
+                work()
+            except BaseException as e:   # re-raised at wait()
+                self._err = e
+            self._t1 = time.perf_counter()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def wait(self):
+        t_enter = time.perf_counter()
+        self._thread.join()
+        dur = max(self._t1 - self._t0, 0.0)
+        overlapped = min(max(min(self._t1, t_enter) - self._t0, 0.0), dur)
+        self._cache._note_overlap(dur, overlapped)
+        if self._err is not None:
+            raise self._err
+        return self
+
+
+class EmbCache:
+    """Hot-row cache over host-DRAM authoritative embedding tables.
+
+    `get_state`/`set_state` abstract where the device slabs live: the
+    executor binding reads/writes the Scope; the serving binding
+    (read_only=True) the engine's resident state dict. All map/slab
+    mutation happens under one lock — prepare_feed on the training
+    thread and prefetch on its background thread interleave safely.
+    """
+
+    def __init__(self, program, tables: Sequence[_CachedTable],
+                 get_state: Callable, set_state: Callable,
+                 read_only: bool = False):
+        self.program = program
+        self.read_only = read_only
+        self._tables: Dict[str, _CachedTable] = {t.name: t for t in tables}
+        self._get_state = get_state
+        self._set_state = set_state
+        self._lock = threading.RLock()
+        self._prefetch_seconds = 0.0
+        self._overlap_seconds = 0.0
+        self._flush_bytes = 0
+        # feed id var name -> table name (one table may read several)
+        self._ids_to_table: Dict[str, str] = {}
+        for t in tables:
+            for n in t.ids_inputs:
+                self._ids_to_table[n] = t.name
+
+    # --- introspection ------------------------------------------------------
+    def tables(self) -> Dict[str, _CachedTable]:
+        return dict(self._tables)
+
+    def feed_id_names(self) -> List[str]:
+        return sorted(self._ids_to_table)
+
+    def owns(self, state_name: str) -> bool:
+        return any(state_name in t.state_names
+                   for t in self._tables.values())
+
+    def hit_rate(self, table: Optional[str] = None) -> float:
+        with self._lock:
+            ts = ([self._tables[table]] if table
+                  else list(self._tables.values()))
+            h = sum(t.hits for t in ts)
+            m = sum(t.misses for t in ts)
+        return h / (h + m) if (h + m) else 0.0
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "hits": sum(t.hits for t in self._tables.values()),
+                "misses": sum(t.misses for t in self._tables.values()),
+                # first-touch misses: no eviction policy avoids these, so
+                # policy gates subtract them from the miss denominator
+                "compulsory_misses": sum(t.miss_compulsory
+                                         for t in self._tables.values()),
+                "hit_rate": self.hit_rate(),
+                "evictions": sum(t.evictions
+                                 for t in self._tables.values()),
+                "flush_bytes": self._flush_bytes,
+                "prefetch_seconds": self._prefetch_seconds,
+                "overlap_seconds": self._overlap_seconds,
+                "overlap_fraction": (
+                    self._overlap_seconds / self._prefetch_seconds
+                    if self._prefetch_seconds > 0 else 0.0),
+                "tables": {
+                    n: {"cache_rows": t.cache_rows, "rows": t.rows,
+                        "resident": int((t.slot2id >= 0).sum()),
+                        "hits": t.hits, "misses": t.misses,
+                        "compulsory_misses": t.miss_compulsory,
+                        "evictions": t.evictions}
+                    for n, t in self._tables.items()},
+            }
+
+    # --- residency core -----------------------------------------------------
+    def _slab(self, name: str):
+        v = self._get_state(name)
+        if v is None:
+            raise RuntimeError(
+                f"emb_cache: device slab '{name}' vanished from its "
+                f"store — was the scope cleared after enable()?")
+        return v.array() if hasattr(v, "array") else v
+
+    def _ensure(self, t: _CachedTable, uniq: np.ndarray, count: bool,
+                counts: Optional[np.ndarray] = None,
+                premissed: Optional[np.ndarray] = None):
+        """Make every id of sorted-unique `uniq` resident. Caller holds
+        the lock. `counts` are per-id occurrence counts aligned to
+        `uniq` (default 1 each) — hit/miss telemetry and the eviction
+        frequency signal are lookup-weighted. `count=False` skips
+        telemetry entirely (prefetch staging: the consuming prepare_feed
+        counts instead, passing the prefetch's miss set as `premissed`
+        so rows the prefetch staged still count as misses — hidden
+        latency is still transfer traffic)."""
+        import jax.numpy as jnp
+
+        if uniq.size and (int(uniq[0]) < 0 or int(uniq[-1]) >= t.rows):
+            bad = uniq[(uniq < 0) | (uniq >= t.rows)]
+            raise ValueError(
+                f"emb_cache: ids out of range for table '{t.name}' "
+                f"([0, {t.rows})): {bad[:8].tolist()}")
+        if counts is None:
+            counts = np.ones(uniq.size, dtype=np.int64)
+        slots = t.id2slot[uniq]
+        miss_mask = slots < 0
+        if count:
+            cmask = (np.isin(uniq, premissed, assume_unique=True)
+                     if premissed is not None else miss_mask)
+            n_miss = int(counts[cmask].sum())
+            n_hit = int(counts.sum()) - n_miss
+            t.hits += n_hit
+            t.misses += n_miss
+            t.miss_compulsory += int(counts[cmask & ~t.ever[uniq]].sum())
+            t.ever[uniq] = True
+            self._record_rate(t, n_hit, n_miss)
+        t.tick += 1
+        hit_slots = slots[~miss_mask]
+        t.freq[hit_slots] += counts[~miss_mask]
+        t.last_used[hit_slots] = t.tick
+        n_miss = int(miss_mask.sum())
+        if n_miss == 0:
+            return
+        if int(uniq.size) > t.cache_rows:
+            raise RuntimeError(
+                f"emb_cache: one window touches {uniq.size} unique rows "
+                f"of '{t.name}' but cache_rows={t.cache_rows} — every "
+                f"scanned step runs against one slab, so the window "
+                f"union must fit; raise cache_rows above the touched-row "
+                f"bound or lower the batch size / window length")
+        miss_ids = uniq[miss_mask]
+        # only this request's own hit slots are off-limits (self-eviction
+        # would unmap a row the remapped feed is about to index)
+        blocked = np.zeros(t.cache_rows, dtype=bool)
+        blocked[hit_slots] = True
+        free = np.flatnonzero((t.slot2id < 0) & ~blocked)
+        victims = free[:n_miss]
+        need = n_miss - victims.size
+        if need > 0:
+            occ = np.flatnonzero((t.slot2id >= 0) & ~blocked)
+            # LRU with frequency tiebreak: oldest last_used first, and
+            # among equals the least-frequently-hit slot goes
+            order = np.lexsort((t.freq[occ], t.last_used[occ]))
+            evict = occ[order[:need]]
+            self._evict(t, evict)
+            victims = np.concatenate([victims, evict])
+        t.slot2id[victims] = miss_ids
+        t.id2slot[miss_ids] = victims.astype(np.int32)
+        t.freq[victims] = counts[miss_mask]
+        t.last_used[victims] = t.tick
+        t.dirty[victims] = False
+        jvict = jnp.asarray(victims)
+        for name in t.state_names:
+            cur = jnp.asarray(self._slab(name))
+            staged = jnp.asarray(t.host[name][miss_ids])
+            self._set_state(name, cur.at[jvict].set(staged))
+
+    def _evict(self, t: _CachedTable, slots: np.ndarray):
+        """Flush dirty victims to the host slab, then unmap. Runs off
+        the critical path when reached from prefetch's thread."""
+        old_ids = t.slot2id[slots]
+        live = old_ids >= 0
+        dirty = slots[live & t.dirty[slots]]
+        if dirty.size and not self.read_only:
+            ids = t.slot2id[dirty]
+            flushed = 0
+            for name in t.state_names:
+                vals = np.asarray(self._slab(name)[dirty])
+                t.host[name][ids] = vals
+                flushed += vals.nbytes
+            self._record_flush(flushed)
+        t.id2slot[old_ids[live]] = -1
+        t.slot2id[slots] = -1
+        t.dirty[slots] = False
+        t.evictions += int(slots.size)
+        from .. import telemetry
+        telemetry.counter(
+            "emb_cache_evictions_total",
+            "hot-row cache slots evicted, by victim-selection policy",
+            labels=("policy",)).labels(policy=_EVICT_POLICY).inc(
+                int(slots.size))
+
+    # --- public protocol ----------------------------------------------------
+    def prepare_feed(self, feed: Dict) -> Dict:
+        """Ensure residency for every cached-table id in `feed` and
+        return the feed with ids remapped to cache-slot indices. Works
+        for per-step [B, ...] and window-stacked [K, B, ...] id arrays
+        alike (the union of the whole window must be resident at once —
+        the scanned steps all run against one slab)."""
+        present = [n for n in self._ids_to_table if n in feed]
+        if not present:
+            return feed
+        out = dict(feed)
+        with self._lock:
+            by_table: Dict[str, List[str]] = {}
+            for n in present:
+                by_table.setdefault(self._ids_to_table[n], []).append(n)
+            for tname, names in sorted(by_table.items()):
+                t = self._tables[tname]
+                arrs = {}
+                for n in names:
+                    v = feed[n]
+                    if getattr(v, "lod", None):
+                        raise ValueError(
+                            f"emb_cache: LoDTensor ids ('{n}') are not "
+                            f"supported for cached table '{tname}'")
+                    arrs[n] = np.asarray(v.array() if hasattr(v, "array")
+                                         else v)
+                uniq, counts = np.unique(
+                    np.concatenate([a.ravel() for a in arrs.values()]),
+                    return_counts=True)
+                uniq = uniq.astype(np.int64)
+                premissed = self._consume_prefetch(t, uniq)
+                self._ensure(t, uniq, count=True,
+                             counts=counts.astype(np.int64),
+                             premissed=premissed)
+                if not self.read_only:
+                    # the dispatched step scatter-applies into exactly
+                    # these slots — they diverge from the host slab
+                    t.dirty[t.id2slot[uniq]] = True
+                for n, a in arrs.items():
+                    out[n] = t.id2slot[a].astype(a.dtype)
+        return out
+
+    def _consume_prefetch(self, t: _CachedTable, uniq: np.ndarray
+                          ) -> Optional[np.ndarray]:
+        """If the last prefetch covered this exact request, return the
+        ids it staged as misses (for occurrence-weighted counting in
+        prepare_feed — a prefetched row is still miss traffic, just
+        latency-hidden); None when no usable prefetch is pending."""
+        pending, t.prefetch_pending = t.prefetch_pending, None
+        if pending is None:
+            return None
+        puniq, pmissed = pending
+        if uniq.size and bool(np.isin(uniq, puniq,
+                                      assume_unique=True).all()):
+            return pmissed
+        return None
+
+    def prefetch(self, uniq_map: Dict[str, np.ndarray]) -> _PrefetchHandle:
+        """Stage the next window's rows in a background thread while the
+        current window computes. `uniq_map` maps feed id names (or table
+        names) to unique-id arrays — the shape next_window(...,
+        sparse_slots=[...]) returns. Call handle.wait() before the next
+        prepare_feed: the maps are shared state."""
+        staged: Dict[str, np.ndarray] = {}
+        for key, ids in (uniq_map or {}).items():
+            tname = self._ids_to_table.get(key, key)
+            if tname not in self._tables:
+                continue
+            ids = np.asarray(ids).ravel().astype(np.int64)
+            prev = staged.get(tname)
+            staged[tname] = (ids if prev is None
+                             else np.concatenate([prev, ids]))
+
+        def work():
+            from .. import telemetry
+            with self._lock:
+                for tname, ids in sorted(staged.items()):
+                    t = self._tables[tname]
+                    uniq = np.unique(ids)
+                    missed = uniq[t.id2slot[uniq] < 0]
+                    self._ensure(t, uniq, count=False)
+                    t.prefetch_pending = (uniq, missed)
+            telemetry.counter(
+                "emb_cache_prefetch_total",
+                "background hot-row prefetches issued").inc()
+
+        return _PrefetchHandle(self, work)
+
+    def flush(self) -> int:
+        """Write every dirty slot back to the host slab (checkpoint
+        barrier; io.save_vars calls this before substituting the host
+        slab for the device slab). Returns bytes flushed."""
+        total = 0
+        with self._lock:
+            for t in self._tables.values():
+                d = np.flatnonzero(t.dirty & (t.slot2id >= 0))
+                if not d.size:
+                    t.dirty[:] = False
+                    continue
+                ids = t.slot2id[d]
+                for name in t.state_names:
+                    vals = np.asarray(self._slab(name)[d])
+                    t.host[name][ids] = vals
+                    total += vals.nbytes
+                t.dirty[:] = False
+        if total:
+            self._record_flush(total)
+        return total
+
+    def host_value(self, state_name: str) -> Optional[np.ndarray]:
+        """The authoritative host slab for a cached state var (None when
+        the var is not cached). Call flush() first for current values."""
+        with self._lock:
+            for t in self._tables.values():
+                if state_name in t.state_names:
+                    return t.host[state_name]
+        return None
+
+    def load_host(self, state_name: str, arr: np.ndarray) -> bool:
+        """Checkpoint-restore path: replace the host slab and invalidate
+        the owning table's residency (every slot re-stages on first
+        touch). Returns False when the var is not cached."""
+        with self._lock:
+            for t in self._tables.values():
+                if state_name not in t.state_names:
+                    continue
+                arr = np.ascontiguousarray(arr)
+                if arr.shape != t.host[state_name].shape:
+                    raise ValueError(
+                        f"emb_cache: restore of '{state_name}' has shape "
+                        f"{arr.shape}, expected "
+                        f"{t.host[state_name].shape} (the checkpoint "
+                        f"holds the FULL host table, not the cache slab)")
+                t.host[state_name] = arr
+                t.id2slot[:] = -1
+                t.slot2id[:] = -1
+                t.freq[:] = 0
+                t.last_used[:] = 0
+                t.dirty[:] = False
+                t.prefetch_pending = None
+                return True
+        return False
+
+    # --- telemetry ----------------------------------------------------------
+    def _record_rate(self, t: _CachedTable, n_hit: int, n_miss: int):
+        from .. import telemetry
+        if n_hit:
+            telemetry.counter(
+                "emb_cache_hits_total", "hot-row cache id hits",
+                labels=("table",)).labels(table=t.name).inc(n_hit)
+        if n_miss:
+            telemetry.counter(
+                "emb_cache_misses_total",
+                "hot-row cache id misses (rows staged from host DRAM)",
+                labels=("table",)).labels(table=t.name).inc(n_miss)
+        total = t.hits + t.misses
+        if total:
+            telemetry.gauge(
+                "emb_cache_hit_rate",
+                "cumulative hot-row cache hit rate (hits / ids resolved)",
+                labels=("table",)).labels(table=t.name).set(
+                    t.hits / total)
+
+    def _note_overlap(self, dur: float, overlapped: float):
+        from .. import telemetry
+        with self._lock:
+            self._prefetch_seconds += dur
+            self._overlap_seconds += overlapped
+            frac = (self._overlap_seconds / self._prefetch_seconds
+                    if self._prefetch_seconds > 0 else 0.0)
+        telemetry.gauge(
+            "emb_cache_prefetch_overlap_fraction",
+            "fraction of prefetch wall time hidden behind the in-flight "
+            "window's compute").set(frac)
+
+    def _record_flush(self, nbytes: int):
+        from .. import telemetry
+        with self._lock:
+            self._flush_bytes += int(nbytes)
+        telemetry.counter(
+            "emb_cache_flush_bytes_total",
+            "dirty hot-row bytes written back to the host-DRAM "
+            "authoritative store").inc(int(nbytes))
+
+
+# --- activation -------------------------------------------------------------
+
+def _discover(program, only: Optional[Sequence[str]] = None) -> Dict[str, Dict]:
+    """{table name: {dim, rows, ids, ops}} for every lookup_table W the
+    cache could serve, with the op-set / padding / sharding / sparse
+    validations that keep the slot remap sound."""
+    blk = program.global_block()
+    found: Dict[str, Dict] = {}
+    produced = {n for op in blk.ops for n in op.output_arg_names}
+    for op in blk.ops:
+        if op.type != "lookup_table":
+            continue
+        wname = (op.input("W") or [None])[0]
+        ids = (op.input("Ids") or [None])[0]
+        if not wname or not blk.has_var(wname):
+            continue
+        if only is not None and wname not in only:
+            continue
+        if int(op.desc.attrs.get("padding_idx", -1)) >= 0:
+            raise ValueError(
+                f"emb_cache: table '{wname}' uses padding_idx — the "
+                f"lookup compares raw ids against it, which slot "
+                f"remapping breaks; drop padding_idx or the cache")
+        if not op.desc.attrs.get("is_sparse", False):
+            raise ValueError(
+                f"emb_cache: table '{wname}' has is_sparse=False — a "
+                f"dense gradient would update every cache slot "
+                f"(including stale tenants); build the embedding with "
+                f"is_sparse=True so only touched slots scatter-apply")
+        if ids in produced:
+            raise ValueError(
+                f"emb_cache: ids '{ids}' of table '{wname}' are computed "
+                f"in-graph; the cache remaps ids at feed time, so they "
+                f"must be a fed input")
+        ent = found.setdefault(wname, {"ids": [], "ops": []})
+        if ids not in ent["ids"]:
+            ent["ids"].append(ids)
+    from . import embedding as embedding_mod
+    for wname, ent in found.items():
+        if wname in embedding_mod.sharded_tables(program):
+            raise ValueError(
+                f"emb_cache: table '{wname}' is row-sharded "
+                f"(_sharded_tables) — the hot-row cache replaces the "
+                f"beyond-HBM role of sharding; pick one per table")
+        offenders = []
+        for i, op in enumerate(blk.ops):
+            names = set(op.input_arg_names) | set(op.output_arg_names)
+            if wname in names and op.type not in CACHE_AWARE_OPS:
+                offenders.append(f"op[{i}] {op.type}")
+        if offenders:
+            raise ValueError(
+                f"emb_cache: table '{wname}' is referenced by "
+                f"{offenders}, which have no slot-remap path "
+                f"(CACHE_AWARE_OPS = {sorted(CACHE_AWARE_OPS)}) — such "
+                f"an op would index the cache slab with global row ids")
+        shp = tuple(blk.var(wname).shape or ())
+        ent["rows"] = int(shp[0])
+        ent["dim"] = int(shp[1]) if len(shp) > 1 else 1
+    return found
+
+
+def enable(program, budget_bytes: Optional[int] = None,
+           tables: Optional[Dict[str, int]] = None, scope=None,
+           headroom=None, batch: Optional[int] = None,
+           limit_bytes: Optional[int] = None,
+           window_feed_bytes: int = 0) -> Optional[EmbCache]:
+    """Activate the hot-row cache on `program` (call AFTER the startup
+    program ran and the optimizer was applied — the table and its
+    accumulators must already exist in the scope).
+
+    cache_rows per table comes from, in priority order: an explicit
+    `tables={name: cache_rows}` entry, a layers.embedding(cache_rows=N)
+    request, or `budget_bytes` split evenly over the remaining tables
+    (each row costs dim * itemsize * (1 + n_accumulators) device
+    bytes). Pass `headroom=` (a memory.HeadroomModel) + `batch=` to
+    derive the budget from measured headroom minus the window feed
+    buffer instead. A table whose cache_rows would cover the whole
+    table is left uncached (it fits in HBM already).
+
+    Swaps the scope's full [rows, dim] arrays for [cache_rows, dim]
+    slabs, keeps the full arrays as host-DRAM authoritative slabs, and
+    installs the EmbCache on `program._emb_cache` — the executor remaps
+    feeds automatically from then on. Returns the cache (None when the
+    PADDLE_TPU_EMB_CACHE kill-switch is off or nothing needs caching).
+    """
+    if not cache_enabled():
+        return None
+    from .. import executor as executor_mod
+    from . import embedding as embedding_mod
+    scope = scope if scope is not None else executor_mod.global_scope()
+    if getattr(program, "_emb_cache", None) is not None:
+        return program._emb_cache
+    requested = requested_rows(program)
+    if tables is not None:
+        only = list(tables)
+    elif requested:
+        only = list(requested)
+    else:
+        only = None
+    if budget_bytes is None and headroom is not None:
+        if batch is None:
+            raise ValueError("enable(headroom=...) needs batch=")
+        budget_bytes = budget_from_headroom(
+            headroom, batch, limit_bytes, window_feed_bytes)
+    found = _discover(program, only)
+    if not found:
+        return None
+
+    blk = program.global_block()
+    specs: List[_CachedTable] = []
+    sized_by_budget = [
+        w for w in found
+        if not (tables and w in tables) and w not in requested]
+    for wname, ent in sorted(found.items()):
+        state = [wname] + embedding_mod.table_accumulators(program, wname)
+        if tables and wname in tables:
+            cache_rows = int(tables[wname])
+        elif wname in requested:
+            cache_rows = int(requested[wname])
+        elif budget_bytes is not None:
+            v = scope.find_var(wname)
+            itemsize = (np.asarray(v).dtype.itemsize
+                        if v is not None else 4)
+            cache_rows = rows_for_budget(
+                max(0, int(budget_bytes)) // max(1, len(sized_by_budget)),
+                ent["dim"], itemsize, len(state))
+        else:
+            raise ValueError(
+                f"emb_cache.enable: no cache_rows for table '{wname}' — "
+                f"pass budget_bytes=/tables= or build the layer with "
+                f"cache_rows=")
+        if cache_rows >= ent["rows"]:
+            continue   # fits in HBM as-is; nothing to cache
+        if cache_rows < 1:
+            raise ValueError(
+                f"emb_cache.enable: budget leaves {cache_rows} cache "
+                f"rows for table '{wname}' ({ent['rows']}x{ent['dim']}) "
+                f"— raise budget_bytes")
+        t = _CachedTable(wname, ent["rows"], ent["dim"], cache_rows,
+                         state, ent["ids"])
+        for name in state:
+            v = scope.find_var(name)
+            if v is None:
+                raise RuntimeError(
+                    f"emb_cache.enable: '{name}' is absent from the "
+                    f"scope — run the startup program (and the "
+                    f"optimizer's minimize) before enabling the cache")
+            host = np.array(np.asarray(
+                v.array() if hasattr(v, "array") else v))
+            if host.shape[0] != ent["rows"]:
+                raise ValueError(
+                    f"emb_cache.enable: scope var '{name}' has "
+                    f"{host.shape[0]} rows, table declares {ent['rows']}")
+            t.host[name] = host
+            scope.set_var(
+                name, np.zeros((cache_rows,) + host.shape[1:],
+                               dtype=host.dtype))
+        specs.append(t)
+    if not specs:
+        return None
+
+    cache = EmbCache(
+        program, specs,
+        get_state=scope.find_var,
+        set_state=scope.set_var)
+    program._emb_cache = cache
+    # state avals changed shape: invalidate executor-compiled blocks
+    program._version = getattr(program, "_version", 0) + 1
+    from .. import telemetry
+    telemetry.log_event(
+        "emb_cache_enable",
+        tables={t.name: {"rows": t.rows, "cache_rows": t.cache_rows,
+                         "state": len(t.state_names)} for t in specs},
+        budget_bytes=budget_bytes)
+    return cache
+
+
+def enable_serving(engine, budget_bytes: Optional[int] = None,
+                   tables: Optional[Dict[str, int]] = None
+                   ) -> Optional[EmbCache]:
+    """Read-only variant for serving.ServingEngine: the engine's
+    device-resident state dict holds the cache slab, per-request ids
+    remap under the engine lock, misses stage from the host slab, and
+    eviction never flushes (host DRAM stays authoritative — inference
+    never writes rows). Called by ServingEngine when constructed with
+    emb_cache_budget_bytes= / emb_cache_tables=."""
+    if not cache_enabled():
+        return None
+    program = engine.program
+    from . import embedding as embedding_mod   # noqa: F401 (parity import)
+    found = _discover(program, list(tables) if tables else None)
+    found = {w: e for w, e in found.items()
+             if set(e["ids"]) <= set(engine.feed_names)}
+    if not found:
+        return None
+    specs: List[_CachedTable] = []
+    for wname, ent in sorted(found.items()):
+        host = np.array(np.asarray(engine._state[wname]))
+        if host.shape[0] != ent["rows"]:
+            raise ValueError(
+                f"emb_cache.enable_serving: resident '{wname}' has "
+                f"{host.shape[0]} rows but the program declares "
+                f"{ent['rows']} — the saved model appears to hold a "
+                f"cache slab instead of the full table (was it exported "
+                f"without flushing the training-side cache?)")
+        itemsize = host.dtype.itemsize
+        if tables and wname in tables:
+            cache_rows = int(tables[wname])
+        elif budget_bytes is not None:
+            cache_rows = rows_for_budget(
+                max(0, int(budget_bytes)) // max(1, len(found)),
+                ent["dim"], itemsize, 1)
+        else:
+            raise ValueError("enable_serving needs budget_bytes= or "
+                             "tables=")
+        if cache_rows >= ent["rows"]:
+            continue
+        if cache_rows < 1:
+            raise ValueError(
+                f"emb_cache.enable_serving: budget leaves {cache_rows} "
+                f"cache rows for '{wname}' — raise the budget")
+        t = _CachedTable(wname, ent["rows"], ent["dim"], cache_rows,
+                         [wname], ent["ids"])
+        t.host[wname] = host
+        engine._state[wname] = np.zeros(
+            (cache_rows,) + host.shape[1:], dtype=host.dtype)
+        specs.append(t)
+    if not specs:
+        return None
+    cache = EmbCache(
+        program, specs,
+        get_state=lambda n: engine._state.get(n),
+        set_state=lambda n, v: engine._state.__setitem__(n, v),
+        read_only=True)
+    from .. import telemetry
+    telemetry.log_event(
+        "emb_cache_enable_serving",
+        tables={t.name: {"rows": t.rows, "cache_rows": t.cache_rows}
+                for t in specs})
+    return cache

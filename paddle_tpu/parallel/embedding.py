@@ -36,8 +36,8 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 __all__ = [
     "SpecLayout", "default_shard_axes", "shard_table", "shard_embeddings",
-    "sharded_tables", "resolve_state_spec", "state_shard_factor",
-    "per_shard_table_bytes",
+    "sharded_tables", "table_accumulators", "resolve_state_spec",
+    "state_shard_factor", "per_shard_table_bytes",
 ]
 
 Axes = Union[str, Sequence[str]]
@@ -145,24 +145,40 @@ def shard_embeddings(program, axis: Optional[Axes] = None,
     return done
 
 
+def table_accumulators(program, pname: str) -> List[str]:
+    """Optimizer accumulators shadowing table `pname`'s shape, by the
+    optimizer.py naming convention (`unique_name.generate(f"{param}_
+    {acc}")`) plus a shape-equality check that keeps scalar state like
+    beta-pow vars (shape [1]) and unlucky name collisions out. Shared
+    vocabulary for the sharding resolver below (a sharded table's
+    moments shard with it) and for parallel/emb_cache.py (a cached
+    table's moments cache — and flush — with it)."""
+    blk = program.global_block()
+    if not blk.has_var(pname):
+        return []
+    pshape = tuple(blk.var(pname).shape or ())
+    if not pshape:
+        return []
+    out = []
+    for vname in list(blk.vars):
+        if vname == pname or not vname.startswith(pname + "_"):
+            continue
+        if not blk.has_var(vname):
+            continue
+        if tuple(blk.var(vname).shape or ()) == pshape:
+            out.append(vname)
+    return sorted(out)
+
+
 def _accum_of(program, name: str) -> Optional[str]:
     """Sharded-table param whose optimizer accumulator `name` is, or
-    None. Accumulators are named `unique_name.generate(f"{param}_{acc}")`
-    (optimizer.py _add_accumulator) and mirror the param's shape; the
-    shape check keeps scalar state like beta-pow vars (shape [1]) and
-    unlucky name collisions replicated."""
+    None (table_accumulators membership over _sharded_tables)."""
     tables = getattr(program, "_sharded_tables", None)
     if not tables:
         return None
-    blk = program.global_block()
     for pname in tables:
-        if not name.startswith(pname + "_"):
-            continue
-        if not (blk.has_var(pname) and blk.has_var(name)):
-            continue
-        pshape = tuple(blk.var(pname).shape or ())
-        ashape = tuple(blk.var(name).shape or ())
-        if pshape and pshape == ashape:
+        if name.startswith(pname + "_") \
+                and name in table_accumulators(program, pname):
             return pname
     return None
 

@@ -105,7 +105,8 @@ class DoubleBufferedFeeder:
             batches.inc()
             yield item
 
-    def next_window(self, k: int, device=None) -> Dict[str, Any]:
+    def next_window(self, k: int, device=None, sparse_slots=None
+                    ) -> Dict[str, Any]:
         """Pull the next k batches and stack each feed name into ONE
         [k, ...] array, `jax.device_put` to `device` — the input half of the
         fused multi-step loop (Executor.run_steps). The producer thread
@@ -122,10 +123,19 @@ class DoubleBufferedFeeder:
 
         With window_prefetch > 1 the stack + device_put happens on a
         background window-builder thread holding up to window_prefetch
-        ready windows in a bounded queue — this call just dequeues."""
+        ready windows in a bounded queue — this call just dequeues.
+
+        sparse_slots=[names]: the emb_cache prefetch hook. The return
+        becomes `(window, {name: unique-id union over the window})` for
+        each listed feed name present, the named slots stay host-side
+        numpy (the cache remaps them to slot indices before they ever
+        reach the device), and the dedup runs on the builder thread
+        under window_prefetch > 1. Batch accounting (dedup, dropped
+        remainder) is identical either way — test-pinned."""
         from .. import telemetry
+        sparse = tuple(sparse_slots) if sparse_slots else None
         if self.window_prefetch > 1:
-            return self._next_window_prefetched(k, device)
+            return self._next_window_prefetched(k, device, sparse)
         if self._consumer is None:
             self._consumer = iter(self)
         feeds: List[Dict[str, Any]] = []
@@ -136,23 +146,29 @@ class DoubleBufferedFeeder:
             self._consumer = None
             self._count_dropped(len(feeds))
             raise StopIteration from None
-        window = self._stack_window(feeds, device)
+        window = self._stack_window(feeds, device, sparse)
         telemetry.counter(
             "input_windows_total",
             "stacked k-step windows delivered by prefetch feeders").inc()
         return window
 
     @staticmethod
-    def _stack_window(feeds: List[Dict[str, Any]], device):
+    def _stack_window(feeds: List[Dict[str, Any]], device,
+                      sparse_slots=None):
         names = set(feeds[0])
         if any(set(f) != names for f in feeds[1:]):
             raise ValueError("window batches must share the same feed names")
         window = {n: np.stack([np.asarray(f[n]) for f in feeds])
                   for n in sorted(names)}
+        uniq = None
+        if sparse_slots is not None:
+            uniq = {n: np.unique(window[n]) for n in sparse_slots
+                    if n in window}
         if device is not None:
-            window = {n: jax.device_put(v, device)
+            skip = set(uniq or ())
+            window = {n: (v if n in skip else jax.device_put(v, device))
                       for n, v in window.items()}
-        return window
+        return (window, uniq) if sparse_slots is not None else window
 
     @staticmethod
     def _count_dropped(n: int):
@@ -163,12 +179,13 @@ class DoubleBufferedFeeder:
                 "end-of-pass remainder batches shorter than the "
                 "window").inc(n)
 
-    def _produce_windows(self, k: int, device, wq, wstop):
+    def _produce_windows(self, k: int, device, wq, wstop,
+                         sparse_slots=None):
         """Window-builder thread body: pull k batches at a time from the
-        batch pipeline, stack + device_put, enqueue the ready window.
-        `wq`/`wstop` are locals (not self attributes) so a builder
-        abandoned by a (k, device) change can neither pollute its
-        replacement's queue nor block forever on its own."""
+        batch pipeline, stack + device_put (+ sparse-slot dedup), enqueue
+        the ready window. `wq`/`wstop` are locals (not self attributes)
+        so a builder abandoned by a (k, device) change can neither
+        pollute its replacement's queue nor block forever on its own."""
         def _put(item):
             while not wstop.is_set():
                 try:
@@ -189,14 +206,15 @@ class DoubleBufferedFeeder:
                     self._count_dropped(len(feeds))
                     _put(_STOP)
                     return
-                if not _put(self._stack_window(feeds, device)):
+                if not _put(self._stack_window(feeds, device,
+                                               sparse_slots)):
                     return
         except BaseException as e:        # surface in the consumer
             _put(e)
 
-    def _next_window_prefetched(self, k: int, device):
+    def _next_window_prefetched(self, k: int, device, sparse_slots=None):
         from .. import telemetry
-        key = (k, device)
+        key = (k, device, sparse_slots)
         if self._wthread is None or self._wkey != key:
             self._stop_windows()
             self._wkey = key
@@ -204,7 +222,8 @@ class DoubleBufferedFeeder:
             self._wqueue = queue.Queue(maxsize=self.window_prefetch)
             self._wthread = threading.Thread(
                 target=self._produce_windows,
-                args=(k, device, self._wqueue, self._wstop), daemon=True)
+                args=(k, device, self._wqueue, self._wstop, sparse_slots),
+                daemon=True)
             self._wthread.start()
         item = self._wqueue.get()
         if item is _STOP:

@@ -104,7 +104,9 @@ class ServingEngine:
                  fetch_names: Optional[Sequence[str]] = None, place=None,
                  scope=None, max_batch: int = DEFAULT_MAX_BATCH,
                  buckets: Optional[Sequence[int]] = None,
-                 cache_capacity: Optional[int] = None):
+                 cache_capacity: Optional[int] = None,
+                 emb_cache_budget_bytes: Optional[int] = None,
+                 emb_cache_tables: Optional[Dict[str, int]] = None):
         from .. import io as io_mod
         from ..executor import (Executor, Scope, TPUPlace, scope_guard,
                                 global_scope)
@@ -183,6 +185,19 @@ class ServingEngine:
             arr = np.asarray(v.array() if hasattr(v, "array") else v)
             self._state[n] = arr if mesh is not None \
                 else jax.device_put(arr, self.device)
+
+        # beyond-HBM tables (read-only hot-row cache, ISSUE 14): swap the
+        # resident full table for a [cache_rows, dim] slab backed by a
+        # host-DRAM authoritative copy; per-request ids remap to cache
+        # slots under the engine lock in run_batch. Inference never
+        # writes rows, so eviction never flushes. Must run before the
+        # first bucket executable is lowered — the state avals change.
+        self._emb_cache = None
+        if emb_cache_budget_bytes is not None or emb_cache_tables:
+            from ..parallel import emb_cache as emb_cache_mod
+            self._emb_cache = emb_cache_mod.enable_serving(
+                self, budget_bytes=emb_cache_budget_bytes,
+                tables=emb_cache_tables)
 
         self._executables: "collections.OrderedDict[int, object]" = \
             collections.OrderedDict()
@@ -319,8 +334,14 @@ class ServingEngine:
                 f"split the request (infer() chunks automatically)")
         rows = valid_rows if valid_rows is not None else n
         bucket = self.bucket_for(n)
-        padded = {name: _pad_rows(a, bucket) for name, a in arrays.items()}
         with self._lock:
+            if self._emb_cache is not None:
+                # ids -> cache slots (misses stage from the host slab
+                # into self._state's slab); padding afterwards repeats
+                # the last row, so pad rows carry valid slot ids
+                arrays = self._emb_cache.prepare_feed(arrays)
+            padded = {name: _pad_rows(a, bucket)
+                      for name, a in arrays.items()}
             ex = self._executable(bucket)
             fetch, _lens, new_state = ex(padded, self._state,
                                          np.uint32(0))
@@ -370,7 +391,7 @@ class ServingEngine:
 
     # --- lifecycle / introspection ------------------------------------------
     def stats(self) -> Dict[str, object]:
-        return {
+        out = {
             "cache_hits": self.cache_hits,
             "cache_misses": self.cache_misses,
             "evictions": self.evictions,
@@ -378,6 +399,9 @@ class ServingEngine:
             "buckets": list(self.buckets),
             "resident_state": len(self._state or ()),
         }
+        if self._emb_cache is not None:
+            out["emb_cache"] = self._emb_cache.stats()
+        return out
 
     @property
     def closed(self) -> bool:

@@ -181,6 +181,35 @@ class TestPreflightPass:
         assert d.op_index is not None
         assert "multiple of 128" in (d.hint or "") and "Ci=64" in d.hint
 
+    def test_emb_cache_thrash_warning(self):
+        """ISSUE 14 satellite: a cache_rows request below the static
+        per-step touched-row bound (batch x slots ids can all be
+        distinct) warns BEFORE any step runs — at runtime that config
+        evicts rows staged the same step, and a fused window can fail
+        outright on the union-must-fit check."""
+        def prog(cache_rows):
+            main = fluid.Program()
+            with fluid.program_guard(main, fluid.Program()):
+                with fluid.unique_name.guard():
+                    ids = fluid.layers.data(name="ids", shape=[26],
+                                            dtype="int64")
+                    fluid.layers.embedding(
+                        ids, size=[1000, 8], is_sparse=True,
+                        param_attr=fluid.ParamAttr(name="emb_w"),
+                        cache_rows=cache_rows)
+            return main
+
+        # bound = _PROBE_BATCH(8) x 26 slots = 208 > 64 -> warn
+        report = analyze_program(prog(64), feeds=["ids"], fetches=[])
+        warns = _by_code(report, "emb-cache-thrash")
+        assert warns and warns[0].var == "emb_w"
+        assert not report.errors       # advisory: sizing, not soundness
+        assert "208" in warns[0].message
+        assert "cache_rows" in (warns[0].hint or "")
+        # a bound-covering cache_rows is silent
+        report = analyze_program(prog(256), feeds=["ids"], fetches=[])
+        assert not _by_code(report, "emb-cache-thrash")
+
 
 # ---------------------------------------------------------------------------
 # shipped examples: the acceptance bar is zero error-severity findings

@@ -1,0 +1,400 @@
+"""Beyond-HBM embedding tables (ISSUE 14): device hot-row cache over a
+host-DRAM authoritative store. The contract under test: with the table
+bigger than the device budget, training through the cache is NUMERICALLY
+IDENTICAL to the all-HBM path — bitwise for sgd/momentum, tolerance for
+adam — because feed-time id→slot remapping is elementwise and the
+scatter-apply kernels (PR 10) run unmodified against the slab. Plus the
+residency machinery itself: LRU-with-frequency eviction, occurrence-
+weighted hit/miss counting with the compulsory/capacity split,
+prefetch's count-later protocol, checkpoint flush ordering, the
+read-only serving variant, and enable()'s soundness validations."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel import emb_cache
+
+ROWS, DIM, BSZ = 120, 8, 16
+
+
+def _build(opt, rows=ROWS):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        lab = fluid.layers.data(name="lab", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(
+            input=ids, size=[rows, DIM], is_sparse=True,
+            param_attr=fluid.ParamAttr(name="emb_w"))
+        pred = fluid.layers.fc(input=emb, size=1,
+                               param_attr=fluid.ParamAttr(name="fc_w"))
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, lab))
+        opt().minimize(loss)
+    return main, startup, loss, pred
+
+
+def _batches(n, rows=ROWS, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, rows, (BSZ, 1)).astype(np.int64),
+             rng.standard_normal((BSZ, 1)).astype(np.float32))
+            for _ in range(n)]
+
+
+def _train(opt, cache_rows, data):
+    """One full run in its own scope/name universe; returns (losses,
+    final table). cache_rows=None is the all-HBM reference."""
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        with fluid.unique_name.guard():
+            main, startup, loss, _ = _build(opt)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        cache = None
+        if cache_rows:
+            cache = emb_cache.enable(main, tables={"emb_w": cache_rows})
+            assert cache is not None
+        losses = []
+        for ids, lab in data:
+            lv, = exe.run(main, feed={"ids": ids, "lab": lab},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+        if cache:
+            cache.flush()
+            w = np.array(cache.host_value("emb_w"))
+        else:
+            w = np.array(scope.find_var("emb_w"))
+    return np.asarray(losses, np.float32), w
+
+
+class TestParity:
+    """Cached-vs-dense numerics with rows > cache_rows, so the run
+    crosses real evictions (the uniform draws touch most of the table
+    while the slab holds less than half of it)."""
+
+    def test_sgd_bitwise(self):
+        data = _batches(10, seed=0)
+        opt = lambda: fluid.optimizer.SGD(learning_rate=0.1)
+        l0, w0 = _train(opt, None, data)
+        l1, w1 = _train(opt, 48, data)
+        np.testing.assert_array_equal(l0, l1)
+        np.testing.assert_array_equal(w0, w1)
+
+    def test_momentum_bitwise(self):
+        data = _batches(10, seed=1)
+        opt = lambda: fluid.optimizer.Momentum(learning_rate=0.1,
+                                               momentum=0.9)
+        l0, w0 = _train(opt, None, data)
+        l1, w1 = _train(opt, 48, data)
+        np.testing.assert_array_equal(l0, l1)
+        np.testing.assert_array_equal(w0, w1)
+        # the velocity accumulator rides along as a cached slab
+        # (state_names beyond the param itself)
+
+    def test_adam_windowed_with_checkpoint(self, tmp_path):
+        """The full training shape: run_steps fused windows fed by a
+        DoubleBufferedFeeder, a save/load_persistables round-trip at
+        the midpoint (save must flush dirty slots FIRST and checkpoint
+        the host slab, restore must invalidate residency), adam
+        accumulators cached alongside the param. Tolerance, not
+        bitwise: adam's per-element update math reassociates."""
+        from paddle_tpu.reader.pipeline import DoubleBufferedFeeder
+
+        data = _batches(16, seed=2)
+        opt = lambda: fluid.optimizer.Adam(learning_rate=0.01)
+
+        def run(cache_rows, ckpt_dir):
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                with fluid.unique_name.guard():
+                    main, startup, loss, _ = _build(opt)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                cache = None
+                if cache_rows:
+                    cache = emb_cache.enable(
+                        main, tables={"emb_w": cache_rows})
+
+                def train(lo, hi):
+                    f = DoubleBufferedFeeder(
+                        lambda: ({"ids": i, "lab": l}
+                                 for i, l in data[lo:hi]),
+                        window_prefetch=2)
+                    out = []
+                    try:
+                        while True:
+                            o = exe.run_steps(
+                                main, reader=f, steps=4,
+                                fetch_list=[loss], fetch_mode="stack")
+                            out.extend(np.asarray(o[0]).ravel().tolist())
+                    except StopIteration:
+                        pass
+                    finally:
+                        f.stop()
+                    return out
+
+                losses = train(0, 8)
+                fluid.io.save_persistables(exe, str(ckpt_dir), main)
+                fluid.io.load_persistables(exe, str(ckpt_dir), main)
+                losses += train(8, 16)
+                if cache:
+                    cache.flush()
+                    w = np.array(cache.host_value("emb_w"))
+                    assert len(
+                        cache.tables()["emb_w"].state_names) == 3
+                else:
+                    w = np.array(scope.find_var("emb_w"))
+            return np.asarray(losses), w
+
+        l0, w0 = run(None, tmp_path / "dense")
+        # 64 holds a 4-batch window's id union (~52 uniques) but not
+        # the 120-row table: windows still evict each other's rows
+        l1, w1 = run(64, tmp_path / "cached")
+        assert l0.size == l1.size == 16
+        np.testing.assert_allclose(l1, l0, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(w1, w0, rtol=1e-5, atol=1e-6)
+
+
+class TestResidency:
+    """The map/eviction machinery driven directly via prepare_feed on
+    a tiny enabled program — no training, just residency transitions."""
+
+    def _cache(self, cache_rows=3, rows=6):
+        self.scope = fluid.Scope()
+        self._guard = fluid.scope_guard(self.scope)
+        self._guard.__enter__()
+        try:
+            with fluid.unique_name.guard():
+                main, startup, _, _ = _build(
+                    lambda: fluid.optimizer.SGD(learning_rate=0.1),
+                    rows=rows)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return emb_cache.enable(main,
+                                    tables={"emb_w": cache_rows})
+        finally:
+            self._guard.__exit__(None, None, None)
+
+    def _feed(self, cache, ids):
+        return cache.prepare_feed(
+            {"ids": np.asarray(ids, np.int64).reshape(-1, 1)})
+
+    def test_counting_and_compulsory_split(self):
+        c = self._cache()
+        # occurrence-weighted: id 0 appears twice -> 2 misses, not 1
+        self._feed(c, [0, 0, 1, 2])
+        s = c.stats()
+        assert (s["hits"], s["misses"]) == (0, 4)
+        assert s["compulsory_misses"] == 4       # all first-ever touch
+        # 0,1 hit; 3 is a first touch -> compulsory miss; full cache
+        # means 3 evicts someone
+        self._feed(c, [0, 1, 3])
+        s = c.stats()
+        assert (s["hits"], s["misses"]) == (2, 5)
+        assert s["compulsory_misses"] == 5
+        assert s["evictions"] == 1
+        # 2 was the eviction victim; re-touching it is the CAPACITY
+        # miss — the only kind an eviction-policy gate may count
+        t = c.tables()["emb_w"]
+        assert t.id2slot[2] == -1
+        self._feed(c, [2])
+        s = c.stats()
+        assert s["misses"] == 6
+        assert s["compulsory_misses"] == 5       # unchanged: seen before
+
+    def test_lru_freq_victim_choice(self):
+        c = self._cache()
+        self._feed(c, [0, 0, 1, 2])   # same tick: freq 0:2, 1:1, 2:1
+        self._feed(c, [1])            # 1 most recent
+        # victim must be 2: among {0, 2} (LRU ties at tick 1), the
+        # frequency tiebreak keeps the hotter row 0
+        self._feed(c, [3])
+        t = c.tables()["emb_w"]
+        assert t.id2slot[2] == -1
+        assert t.id2slot[0] >= 0 and t.id2slot[1] >= 0
+
+    def test_remap_matches_slots_and_marks_dirty(self):
+        c = self._cache()
+        out = self._feed(c, [4, 1, 4])
+        t = c.tables()["emb_w"]
+        np.testing.assert_array_equal(
+            out["ids"].ravel(), t.id2slot[[4, 1, 4]])
+        assert out["ids"].dtype == np.int64     # dtype preserved
+        assert t.dirty[t.id2slot[[4, 1]]].all()
+
+    def test_window_union_must_fit(self):
+        c = self._cache(cache_rows=3)
+        with pytest.raises(RuntimeError, match="window union must fit"):
+            self._feed(c, [0, 1, 2, 3])
+
+    def test_out_of_range_ids_rejected(self):
+        c = self._cache(rows=6)
+        with pytest.raises(ValueError, match="out of range"):
+            self._feed(c, [0, 6])
+
+    def test_flush_writes_host_and_clears_dirty(self):
+        c = self._cache()
+        self._feed(c, [0, 1])
+        n = c.flush()
+        t = c.tables()["emb_w"]
+        # param + sgd has no accumulator -> 2 rows x dim x 4 bytes
+        assert n == 2 * DIM * 4 * len(t.state_names)
+        assert not t.dirty.any()
+        assert c.flush() == 0                    # idempotent
+
+
+class TestPrefetch:
+    def _setup(self, cache_rows=48):
+        scope = fluid.Scope()
+        guard = fluid.scope_guard(scope)
+        guard.__enter__()
+        try:
+            with fluid.unique_name.guard():
+                main, startup, _, _ = _build(
+                    lambda: fluid.optimizer.SGD(learning_rate=0.1))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return emb_cache.enable(main,
+                                    tables={"emb_w": cache_rows})
+        finally:
+            guard.__exit__(None, None, None)
+
+    def test_prefetched_rows_still_count_as_misses(self):
+        """The count-later protocol: prefetch stages silently
+        (count=False), the consuming prepare_feed charges the staged
+        rows as misses — they are transfer traffic whether or not the
+        latency was hidden. Hit/miss totals must be IDENTICAL to the
+        unprefetched run of the same feed."""
+        c = self._setup()
+        ids = np.array([[3], [5], [3], [9]], np.int64)
+        c.prefetch({"ids": np.unique(ids)}).wait()
+        s = c.stats()
+        assert (s["hits"], s["misses"]) == (0, 0)
+        t = c.tables()["emb_w"]
+        assert (t.id2slot[[3, 5, 9]] >= 0).all()   # already resident
+        c.prepare_feed({"ids": ids})
+        s = c.stats()
+        assert (s["hits"], s["misses"]) == (0, 4)
+        assert s["compulsory_misses"] == 4
+        # second touch of the same ids: genuine hits
+        c.prepare_feed({"ids": ids})
+        assert c.stats()["hits"] == 4
+
+    def test_partial_coverage_prefetch_is_discarded(self):
+        c = self._setup()
+        c.prefetch({"ids": np.array([1, 2])}).wait()
+        # the feed touches an id the prefetch never saw -> fall back to
+        # counting from the live map (1, 2 are resident -> hits)
+        c.prepare_feed({"ids": np.array([[1], [2], [7]], np.int64)})
+        s = c.stats()
+        assert (s["hits"], s["misses"]) == (2, 1)
+
+    def test_overlap_accounting(self):
+        c = self._setup()
+        h = c.prefetch({"ids": np.arange(16)})
+        h.wait()
+        s = c.stats()
+        assert s["prefetch_seconds"] > 0
+        assert 0.0 <= s["overlap_fraction"] <= 1.0
+
+
+class TestEnableValidation:
+    def _prog(self, **emb_kw):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data(name="ids", shape=[1],
+                                    dtype="int64")
+            lab = fluid.layers.data(name="lab", shape=[1],
+                                    dtype="float32")
+            emb = fluid.layers.embedding(
+                input=ids, size=[ROWS, DIM],
+                param_attr=fluid.ParamAttr(name="emb_w"), **emb_kw)
+            pred = fluid.layers.fc(input=emb, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, lab))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup
+
+    def test_dense_gradient_rejected(self):
+        with fluid.unique_name.guard():
+            main, _ = self._prog(is_sparse=False)
+        with pytest.raises(ValueError, match="is_sparse=False"):
+            emb_cache.enable(main, tables={"emb_w": 32})
+
+    def test_padding_idx_rejected(self):
+        with fluid.unique_name.guard():
+            main, _ = self._prog(is_sparse=True, padding_idx=0)
+        with pytest.raises(ValueError, match="padding_idx"):
+            emb_cache.enable(main, tables={"emb_w": 32})
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_EMB_CACHE", "0")
+        with fluid.unique_name.guard():
+            main, _ = self._prog(is_sparse=True)
+        assert emb_cache.enable(main, tables={"emb_w": 32}) is None
+
+    def test_table_fitting_in_budget_stays_uncached(self):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            with fluid.unique_name.guard():
+                main, startup = self._prog(is_sparse=True)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            # budget covers the whole table: caching would only add
+            # remap overhead, enable() declines
+            assert emb_cache.enable(
+                main, budget_bytes=ROWS * DIM * 4 * 8) is None
+
+    def test_layer_cache_rows_request_routes_to_enable(self):
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            with fluid.unique_name.guard():
+                main, startup = self._prog(is_sparse=True,
+                                           cache_rows=40)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            c = emb_cache.enable(main)
+            assert c is not None
+            assert c.tables()["emb_w"].cache_rows == 40
+            # device slab really is budget-shaped now
+            assert np.asarray(
+                scope.find_var("emb_w")).shape == (40, DIM)
+
+
+class TestServing:
+    def test_read_only_cache_parity_and_hits(self, tmp_path):
+        from paddle_tpu.serving import ServingEngine
+
+        rng = np.random.default_rng(3)
+        data = _batches(6, seed=3)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            with fluid.unique_name.guard():
+                main, startup, loss, pred = _build(
+                    lambda: fluid.optimizer.SGD(learning_rate=0.1))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            cache = emb_cache.enable(main, tables={"emb_w": 48})
+            for ids, lab in data:
+                exe.run(main, feed={"ids": ids, "lab": lab},
+                        fetch_list=[loss])
+            # export: save flushes dirty slots and checkpoints the
+            # FULL host table, so the engine sees [rows, dim]
+            fluid.io.save_inference_model(
+                str(tmp_path), ["ids"], [pred], exe, main)
+
+        eng0 = ServingEngine(str(tmp_path))
+        eng1 = ServingEngine(str(tmp_path),
+                             emb_cache_budget_bytes=48 * DIM * 4)
+        assert eng1.stats()["emb_cache"]["tables"]["emb_w"][
+            "cache_rows"] == 48
+        q = rng.integers(0, ROWS, (8, 1)).astype(np.int64)
+        (a0,), (a1,) = eng0.run_batch({"ids": q}), eng1.run_batch(
+            {"ids": q})
+        np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+        # repeat ids: the read-only cache must register hits and never
+        # dirty a slot (no flush path at inference)
+        eng1.run_batch({"ids": q})
+        st = eng1.stats()["emb_cache"]
+        assert st["hits"] > 0
+        assert st["flush_bytes"] == 0

@@ -78,6 +78,66 @@ class TestNextWindow:
             dbf.next_window(2)
 
 
+class TestSparseSlots:
+    """next_window(..., sparse_slots=[...]) — the emb_cache prefetch
+    hook (ISSUE 14 satellite): the return becomes (window, {name:
+    sorted unique-id union over the whole window}), the listed slots
+    stay host numpy even when device= is passed (the cache remaps them
+    before the device ever sees them), and batch accounting (order,
+    dedup, dropped remainder) is byte-identical to the plain path."""
+
+    def _feeder(self, n, depth=1):
+        # known overlapping ids: batch i holds {i, i+1, 7}
+        batches = [{"ids": np.array([[i], [i + 1], [7]], np.int64),
+                    "lab": np.full((3, 1), float(i), np.float32)}
+                   for i in range(n)]
+        return DoubleBufferedFeeder(lambda: iter(batches),
+                                    window_prefetch=depth)
+
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_union_is_sorted_unique_over_window(self, depth):
+        dbf = self._feeder(7, depth)
+        win, uniq = dbf.next_window(3, sparse_slots=["ids"])
+        assert set(uniq) == {"ids"}
+        # batches 0,1,2 -> ids {0,1,7} u {1,2,7} u {2,3,7}
+        np.testing.assert_array_equal(uniq["ids"], [0, 1, 2, 3, 7])
+        assert win["ids"].shape == (3, 3, 1)
+        # non-listed slots are untouched; listed slot stays host numpy
+        assert isinstance(win["ids"], np.ndarray)
+        # the SAME pass continues — dedup consumed no extra batches
+        win2, uniq2 = dbf.next_window(3, sparse_slots=["ids"])
+        np.testing.assert_array_equal(win2["lab"][:, 0, 0], [3, 4, 5])
+        np.testing.assert_array_equal(uniq2["ids"], [3, 4, 5, 6, 7])
+        dbf.stop()
+
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_remainder_accounting_unchanged(self, depth):
+        from paddle_tpu import telemetry
+        # snapshot BEFORE the feeder exists: under window_prefetch the
+        # builder thread counts the drop as soon as it exhausts the
+        # pass, which can precede the consumer's StopIteration
+        before = sum(telemetry.read_series(
+            "input_window_dropped_batches_total").values())
+        dbf = self._feeder(7, depth)
+        dbf.next_window(3, sparse_slots=["ids"])
+        dbf.next_window(3, sparse_slots=["ids"])
+        with pytest.raises(StopIteration):
+            dbf.next_window(3, sparse_slots=["ids"])
+        dropped = sum(telemetry.read_series(
+            "input_window_dropped_batches_total").values()) - before
+        assert dropped == 1    # only batch 6 was left on this pass
+        # reusable: fresh pass restarts at batch 0, union included
+        win, uniq = dbf.next_window(3, sparse_slots=["ids"])
+        np.testing.assert_array_equal(win["lab"][:, 0, 0], [0, 1, 2])
+        np.testing.assert_array_equal(uniq["ids"], [0, 1, 2, 3, 7])
+        dbf.stop()
+
+    def test_missing_slot_name_ignored(self):
+        dbf = self._feeder(3)
+        _, uniq = dbf.next_window(3, sparse_slots=["ids", "absent"])
+        assert set(uniq) == {"ids"}
+
+
 class TestWindowPrefetch:
     """window_prefetch > 1 (ISSUE 9 satellite): the stack + device_put
     moves to a background window-builder thread; the stream must stay

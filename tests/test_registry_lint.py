@@ -158,6 +158,35 @@ def test_infer_lint_catches_orphan_and_overlap(monkeypatch):
                for _, m in problems), problems
 
 
+def test_emb_cache_table_consistent():
+    """ISSUE 14 satellite: emb_cache.CACHE_AWARE_OPS must stay exactly
+    the lookup pair plus the SPARSE_APPLY_OPS scatter family, and every
+    member must be sparse-aware in the executor — drift in either
+    direction corrupts silently (enable() rejecting valid optimizers,
+    or a densified grad overwriting stale slot tenants)."""
+    problems = _load_checker().check_emb_cache()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_emb_cache_lint_catches_drift(monkeypatch):
+    """Sanity both ways: an extra CACHE_AWARE_OPS member with no remap
+    semantics trips the converse audit; a shrunken set trips the
+    missing-scatter-op direction."""
+    from paddle_tpu.parallel import emb_cache
+
+    checker = _load_checker()
+    orig = emb_cache.CACHE_AWARE_OPS
+    monkeypatch.setattr(emb_cache, "CACHE_AWARE_OPS",
+                        orig | {"matmul"})
+    problems = checker.check_emb_cache()
+    assert any("'matmul'" in m and "slot-remap" in m
+               for _, m in problems), problems
+
+    monkeypatch.setattr(emb_cache, "CACHE_AWARE_OPS", orig - {"adam"})
+    problems = checker.check_emb_cache()
+    assert any("'adam' missing" in m for _, m in problems), problems
+
+
 def test_serving_programs_clean():
     """ISSUE 13 satellite: both shipped inference programs (transformer
     logits, DLRM probabilities), after the ServingEngine's own

@@ -205,6 +205,65 @@ def check_sparse_table():
     return problems
 
 
+def check_emb_cache():
+    """[(where, message), ...] — pin parallel/emb_cache.CACHE_AWARE_OPS
+    (ISSUE 14) against the layers that make slot remapping sound. The
+    cache swaps a [rows, dim] table for a [cache_rows, dim] slab and
+    remaps feed ids to slots, so exactly two op families may touch a
+    cached table: the lookup pair (gathers by the remapped ids) and the
+    SelectedRows scatter-apply optimizers (their rows ARE the remapped
+    ids). Drift in either direction corrupts silently: a SPARSE_APPLY_OPS
+    member missing from CACHE_AWARE_OPS makes enable() reject valid
+    programs using that optimizer, while a CACHE_AWARE_OPS member that is
+    NOT sparse-aware in the executor densifies its Grad — and a dense
+    update writes EVERY slot, including stale tenants of other rows."""
+    import inspect
+
+    from paddle_tpu import executor
+    from paddle_tpu.ops import sparse_ops
+    from paddle_tpu.parallel import emb_cache
+
+    problems = []
+    aware = emb_cache.CACHE_AWARE_OPS
+    for name in ("lookup_table", "lookup_table_grad"):
+        if name not in aware:
+            problems.append((
+                "emb_cache.CACHE_AWARE_OPS",
+                f"'{name}' missing — enable() would refuse every program "
+                f"containing the op the cache exists to serve"))
+    scatter = set()
+    for t in sparse_ops.SPARSE_APPLY_OPS:
+        for name in (t, "fused_sparse_" + t):
+            scatter.add(name)
+            if name not in aware:
+                problems.append((
+                    "emb_cache.CACHE_AWARE_OPS",
+                    f"'{name}' missing — enable() rejects any cached "
+                    f"table trained with that optimizer even though its "
+                    f"SelectedRows rows are exactly the remapped slots"))
+            if name not in executor._SPARSE_AWARE_OPS:
+                problems.append((
+                    "executor._SPARSE_AWARE_OPS",
+                    f"'{name}' missing — under the hot-row cache its "
+                    f"densified Grad would update every cache slot, "
+                    f"silently corrupting rows resident for other ids"))
+    for name in sorted(aware - scatter
+                       - {"lookup_table", "lookup_table_grad"}):
+        problems.append((
+            "emb_cache.CACHE_AWARE_OPS",
+            f"'{name}' is listed but is neither the lookup pair nor a "
+            f"SPARSE_APPLY_OPS scatter op — no slot-remap semantics "
+            f"justify letting it touch a cache slab"))
+    dsrc = inspect.getsource(emb_cache._discover)
+    if "CACHE_AWARE_OPS" not in dsrc:
+        problems.append((
+            "emb_cache._discover",
+            "table discovery no longer validates referencing ops against "
+            "CACHE_AWARE_OPS — an op with no remap path could index the "
+            "slab with global row ids"))
+    return problems
+
+
 def check_pallas_table():
     """[(where, message), ...] — pin pallas_conv.KERNELS (ISSUE 11)
     against ops/registry.py and fusion.CONV_OPS. Three silent failure
@@ -402,6 +461,9 @@ def main():
     sparse = check_sparse_table()
     for where, msg in sparse:
         print(f"{where}: {msg}")
+    embc = check_emb_cache()
+    for where, msg in embc:
+        print(f"{where}: {msg}")
     pallas = check_pallas_table()
     for where, msg in pallas:
         print(f"{where}: {msg}")
@@ -411,7 +473,8 @@ def main():
     servp = check_serving_programs()
     for where, msg in servp:
         print(f"{where}: {msg}")
-    problems = problems + coll + jit + sparse + pallas + inferp + servp
+    problems = problems + coll + jit + sparse + embc + pallas + inferp \
+        + servp
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")

@@ -27,7 +27,11 @@ embedding net (ISSUE 10): a [SCALE_EMB_ROWS x SCALE_EMB_DIM] table looked
 up by SCALE_EMB_SLOTS features per example, fsdp-row-sharded over the
 mesh, Adam scatter-apply end-to-end. Its per-mesh lines add
 rows_touched_per_sec and table_bytes_per_shard — the memory column falls
-~1/n while throughput holds.
+~1/n while throughput holds. SCALE_EMB_BUDGET=<MB> swaps the sharding
+for the beyond-HBM hot-row cache (ISSUE 14): the table stays unsharded,
+only a budget-sized slab is device-resident, and the lines add
+cache_rows / cache_hit_rate / prefetch_overlap_fraction /
+flush_bytes_per_step (null when the cache is off).
 
 On a CPU host it exercises the identical GSPMD path over virtual devices
 — mechanism check only; the shared core makes the timings say nothing
@@ -167,7 +171,13 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
                     fluid.layers.softmax_with_cross_entropy(logits, label))
                 fluid.optimizer.Adam(learning_rate=1e-3).minimize(
                     avg_cost, startup_program=startup)
-            if n_devices > 1:
+            # SCALE_EMB_BUDGET=<MB> mirrors bench.py's BENCH_EMB_BUDGET:
+            # the beyond-HBM hot-row cache instead of fsdp row-sharding
+            # (mutually exclusive per table) — the table stays unsharded
+            # at every mesh size and only a budget-sized slab is
+            # device-resident; extra columns report cache behavior
+            emb_cfg["budget_mb"] = os.environ.get("SCALE_EMB_BUDGET")
+            if n_devices > 1 and emb_cfg["budget_mb"] is None:
                 from paddle_tpu.parallel import embedding as emb_mod
                 main._mesh = Mesh(np.array(jax.devices()[:n_devices]),
                                   ("fsdp",))
@@ -206,6 +216,12 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
 
         with em.scope_guard(em.Scope()):
             exe.run(startup)
+            emb_cache = None
+            if emb_cfg is not None and emb_cfg.get("budget_mb"):
+                from paddle_tpu.parallel import emb_cache as emb_cache_mod
+                emb_cache = emb_cache_mod.enable(
+                    main, budget_bytes=int(
+                        float(emb_cfg["budget_mb"]) * (1 << 20)))
             if k == "auto":
                 # probe the compiled K=1 path for dispatch overhead, step
                 # time and HBM headroom, then let the overlap pass pick K
@@ -231,6 +247,7 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
             for _ in range(warm_calls):
                 out = run_one()
             float(np.asarray(out).ravel()[0])
+            cache_base = emb_cache.stats() if emb_cache else None
             t0 = time.perf_counter()
             for _ in range(calls):
                 out = run_one()
@@ -251,6 +268,8 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
             if emb_cfg is not None:
                 perf.update(_embedding_fields(
                     main, emb_cfg, batch * steps / dt))
+                perf.update(_emb_cache_fields(emb_cache, cache_base,
+                                              steps))
             perf.update(_analyze_fields(main))
     assert np.isfinite(final)
     return batch * steps / dt, peak_hbm, perf, k
@@ -386,6 +405,31 @@ def _embedding_fields(main, emb_cfg, examples_per_sec):
     except Exception:  # noqa: BLE001 - bytes columns are best-effort
         pass
     return out
+
+
+def _emb_cache_fields(emb_cache, base, steps):
+    """bench.py-mirrored columns for the SCALE_EMB_BUDGET config: hit
+    rate / flush bytes are deltas over the timed phase only (the warmup
+    phase pays the compulsory misses), prefetch overlap is cumulative
+    (null-equivalent 0.0 here — the sweep's fixed-feed loop issues no
+    explicit prefetches; bench.py's BENCH_MODE=embedding drives that
+    path). Columns emit null when the cache is off so the sweep's CSV
+    stays rectangular across configs."""
+    if emb_cache is None:
+        return {"cache_rows": None, "cache_hit_rate": None,
+                "prefetch_overlap_fraction": None,
+                "flush_bytes_per_step": None}
+    s = emb_cache.stats()
+    d_hit = s["hits"] - base["hits"]
+    d_miss = s["misses"] - base["misses"]
+    t = next(iter(emb_cache.tables().values()))
+    return {
+        "cache_rows": t.cache_rows,
+        "cache_hit_rate": round(d_hit / max(d_hit + d_miss, 1), 4),
+        "prefetch_overlap_fraction": round(s["overlap_fraction"], 4),
+        "flush_bytes_per_step": round(
+            (s["flush_bytes"] - base["flush_bytes"]) / max(steps, 1), 1),
+    }
 
 
 def _perf_fields(run_one):
