@@ -301,9 +301,93 @@ def _check_emb_cache(pctx):
                  "per-step touched-row bound, or lower the batch size")
 
 
+def _check_planner(pctx):
+    """Planner-output diagnostics (ISSUE 15), on top of the per-spec
+    checks in _check_shardings:
+
+      * sharding-batch-indivisible — a feed's dim-0 batch does not
+        divide by its data-axis split, so GSPMD pads every step's input;
+      * sharding-overcommit — one tensor dim sharded by an axis product
+        larger than the dim itself (shards would be empty/padded);
+      * norm-sharded — a role the planner keeps replicated on purpose
+        (norm scale/bias, layer bias) carries a spec anyway: legal, but
+        almost always a hand-annotation mistake since the bytes saved
+        are trivial and every use pays a gather.
+    """
+    program = pctx.program
+    mesh = getattr(program, "_mesh", None)
+    if mesh is None:
+        return
+    axis_sizes = dict(getattr(mesh, "shape", None) or {})
+    block = pctx.block
+
+    # feeds: explicit _feed_shardings dim-0 entries vs static batch dims
+    for name, spec in sorted(
+            (getattr(program, "_feed_shardings", None) or {}).items()):
+        if not spec or not block.has_var(name):
+            continue
+        shape = tuple(block.var(name).shape or ())
+        if not shape or int(shape[0]) == -1:
+            continue  # symbolic batch: runtime-sized, nothing to check
+        factor, _missing = _axis_factor(spec[0], axis_sizes)
+        if factor > 1 and int(shape[0]) % factor:
+            pctx.emit(
+                "error", "sharding-batch-indivisible",
+                f"feed '{name}' has batch dim {shape[0]}, not divisible "
+                f"by the {factor}-way data split of spec entry "
+                f"{spec[0]!r}", var=name,
+                hint=f"feed a global batch that is a multiple of "
+                     f"{factor}, or re-plan on a smaller data axis")
+
+    specs = getattr(program, "_param_shardings", None) or {}
+    if not specs:
+        return
+
+    # axis overcommit: one dim split by more ways than it has elements
+    for pname in sorted(specs):
+        v = block.desc.vars.get(pname)
+        if v is None or v.shape is None:
+            continue  # _check_shardings already errors unknown params
+        shape = list(v.shape)
+        for d, entry in enumerate(specs[pname]):
+            if d >= len(shape):
+                break
+            factor, _missing = _axis_factor(entry, axis_sizes)
+            if factor > 1 and 0 < int(shape[d]) < factor:
+                pctx.emit(
+                    "error", "sharding-overcommit",
+                    f"'{pname}' dim {d} has size {shape[d]} but spec "
+                    f"entry {entry!r} splits it {factor} ways — "
+                    f"{factor - int(shape[d])} shard(s) would be empty",
+                    var=pname,
+                    hint="drop one axis from the entry or shard a "
+                         "larger dim")
+
+    # norm/bias roles carrying a spec: replicated-by-design params
+    from ..parallel import planner as planner_mod
+    try:
+        roles = planner_mod.classify_params(program)
+    except Exception:
+        return
+    for pname in sorted(specs):
+        if roles.get(pname) not in ("norm", "bias"):
+            continue
+        if not any(e for e in specs[pname]):
+            continue
+        pctx.emit(
+            "warning", "norm-sharded",
+            f"'{pname}' is a {roles[pname]} parameter (planner keeps "
+            f"these replicated) but carries spec {specs[pname]} — the "
+            f"bytes saved are trivial and every use pays a gather",
+            var=pname,
+            hint="let planner.plan assign this spec, or drop the "
+                 "hand annotation")
+
+
 def run(pctx):
     _check_pallas_convs(pctx)
     _check_shardings(pctx)
     _check_layout(pctx)
     _check_plans(pctx)
     _check_emb_cache(pctx)
+    _check_planner(pctx)

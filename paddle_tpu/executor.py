@@ -1765,11 +1765,13 @@ class Executor:
         seed = program.random_seed or 12345
 
         def _state_spec(n):
-            # accumulators of a row-sharded embedding table inherit the
-            # table's sharding (parallel/embedding.resolve_state_spec) so
-            # adam moments of a 1M-row table never replicate per device
+            # accumulators of ANY sharded parameter inherit its sharding
+            # (parallel/embedding.resolve_state_spec, generalized past
+            # tables by the planner) so adam moments of a 1M-row table —
+            # or an fsdp-sharded fc weight — never replicate per device
             spec = param_specs.get(n)
-            if spec is None and getattr(program, "_sharded_tables", None):
+            if spec is None and (param_specs or
+                                 getattr(program, "_sharded_tables", None)):
                 from .parallel import embedding as embedding_mod
                 spec = embedding_mod.resolve_state_spec(program, n)
             return spec
@@ -1835,12 +1837,13 @@ class Executor:
         # (parallel/embedding.resolve_state_spec); everything else is
         # replicated and XLA GSPMD partitions the consumers
         state_shardings = {}
-        has_tables = bool(getattr(program, "_sharded_tables", None))
-        if has_tables:
+        has_specs = bool(param_specs) or \
+            bool(getattr(program, "_sharded_tables", None))
+        if has_specs:
             from .parallel import embedding as embedding_mod
         for n in state_names:
             spec = param_specs.get(n)
-            if spec is None and has_tables:
+            if spec is None and has_specs:
                 spec = embedding_mod.resolve_state_spec(program, n)
             state_shardings[n] = repl if spec is None else \
                 NamedSharding(mesh, PartitionSpec(*spec))

@@ -56,7 +56,20 @@ __all__ = [
     "is_oom", "maybe_oom_error", "what_if", "default_budget",
     "records", "latest_record", "reset", "memory_report", "bench_summary",
     "crash_section", "build_smoke", "on_compile", "on_run",
+    "per_shard_param_bytes",
 ]
+
+
+def per_shard_param_bytes(program, scope=None):
+    """Per-device parameter bytes under the program's mesh, with the
+    per-axis breakdown (`by_axes`: "replicated" / "fsdp" / "fsdp+tp" /
+    ...) the sharding planner's byte validation pins against
+    (parallel.planner.validate_plan_bytes, <= 1% — a hard test failure
+    on drift). Thin delegation to parallel.per_shard_param_bytes; lives
+    here too because memory accounting callers reach for memory.py
+    first."""
+    from .parallel import per_shard_param_bytes as _impl
+    return _impl(program, scope)
 
 GiB = 1 << 30
 

@@ -10,8 +10,10 @@ from . import tensor_parallel
 from .tensor_parallel import (shard_parameter, shard_fc_params,
                               shard_all_params_zero)
 from . import ring_attention
+from . import planner
+from .planner import SpecLayout, mesh_from_env, validate_plan_bytes
 from . import embedding
-from .embedding import (SpecLayout, shard_table, shard_embeddings,
+from .embedding import (shard_table, shard_embeddings,
                         per_shard_table_bytes)
 from . import emb_cache
 from . import pipeline
@@ -41,7 +43,10 @@ def per_shard_param_bytes(program, scope=None):
     assignment) — this splits the same number into replicated-vs-sharded
     so sweeps (tools/scaling_bench) can see WHY the footprint scales.
     Returns {devices, replicated_bytes, sharded_bytes_per_device,
-    per_device_bytes, params}."""
+    per_device_bytes, by_axes, params}. `by_axes` partitions the
+    per-device bytes by the axis-name set each param shards over —
+    "replicated", "fsdp", "fsdp+tp", ... — the breakdown the planner's
+    byte validation and the SCALE_MODEL=lm bench lines report."""
     from .. import executor as executor_mod
     from .. import memory as memory_mod
 
@@ -54,12 +59,14 @@ def per_shard_param_bytes(program, scope=None):
     specs = getattr(program, "_param_shardings", {}) or {}
     replicated = sharded = 0
     detail = {}
+    by_axes = {}
     for p in program.global_block().all_parameters():
         v = scope.find_var(p.name)
         b = memory_mod.nbytes_of(v)
         if not b:
             continue
         factor = 1
+        spec_axes = set()
         for ent in specs.get(p.name) or ():
             # dim entries may be one axis ("fsdp") or an axis tuple
             # (("fsdp", "tp") — embedding.SpecLayout row sharding)
@@ -67,15 +74,21 @@ def per_shard_param_bytes(program, scope=None):
                     else (ent,) if ent else ())
             for ax in axes:
                 factor *= int(axis_sizes.get(ax, 1))
+                spec_axes.add(str(ax))
         if factor > 1:
             per_dev = -(-b // factor)   # ceil: XLA pads uneven shards
             sharded += per_dev
+            key = "+".join(sorted(spec_axes))
             detail[p.name] = {"bytes": b, "per_device": per_dev,
-                              "factor": factor}
+                              "factor": factor, "axes": key}
         else:
+            per_dev = b
             replicated += b
-            detail[p.name] = {"bytes": b, "per_device": b, "factor": 1}
+            key = "replicated"
+            detail[p.name] = {"bytes": b, "per_device": b, "factor": 1,
+                              "axes": key}
+        by_axes[key] = int(by_axes.get(key, 0) + per_dev)
     return {"devices": n_dev, "replicated_bytes": int(replicated),
             "sharded_bytes_per_device": int(sharded),
             "per_device_bytes": int(replicated + sharded),
-            "params": detail}
+            "by_axes": by_axes, "params": detail}

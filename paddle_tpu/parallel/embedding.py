@@ -31,8 +31,12 @@ not pass one explicitly.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+# The role→spec vocabulary lives in planner.py since ISSUE 15 (one
+# data × fsdp × tp vocabulary); re-exported here because PR 10 callers
+# import it from this module.
+from .planner import SpecLayout
 
 __all__ = [
     "SpecLayout", "default_shard_axes", "shard_table", "shard_embeddings",
@@ -41,41 +45,6 @@ __all__ = [
 ]
 
 Axes = Union[str, Sequence[str]]
-
-
-@dataclass(frozen=True)
-class SpecLayout:
-    """Role map from parameter roles to dim-0-first spec tuples over
-    named mesh axes (SNIPPETS.md [2]): embeddings shard their row (vocab)
-    dim over fsdp×tp and replicate the feature dim; dense layers keep
-    today's tensor_parallel.py specs. Axes absent from the actual mesh
-    are dropped at application time (`filter_axes`), so one layout serves
-    1-device tests and fsdp×tp pods alike."""
-
-    data_axis: str = "dp"
-    fsdp_axis: str = "fsdp"
-    tensor_axis: str = "tp"
-
-    def embeddings(self) -> Tuple:
-        return ((self.fsdp_axis, self.tensor_axis), None)
-
-    def ffn_column(self) -> Tuple:
-        return (None, self.tensor_axis)
-
-    def ffn_row(self) -> Tuple:
-        return (self.tensor_axis, None)
-
-    def filter_axes(self, spec: Tuple, mesh) -> Tuple:
-        """Drop axes the mesh does not have; collapse empty entries to
-        None so the spec stays valid on smaller meshes."""
-        have = set(getattr(mesh, "axis_names", ()) or ())
-        out = []
-        for ent in spec:
-            axes = (tuple(ent) if isinstance(ent, (tuple, list))
-                    else (ent,) if ent else ())
-            axes = tuple(a for a in axes if a in have)
-            out.append(axes[0] if len(axes) == 1 else (axes or None))
-        return tuple(out)
 
 
 def default_shard_axes() -> Tuple[str, ...]:
@@ -91,10 +60,10 @@ def shard_table(program, param_name: str, axis: Optional[Axes] = None):
     in_shardings and the sparse lookup/apply kernels read it — and marks
     the param in `program._sharded_tables` so fallback dashboards can
     label it "handled by sparse path" rather than "sharded param"."""
+    from . import tensor_parallel as tp_mod
+
     axes = (tuple(axis) if isinstance(axis, (tuple, list))
             else (axis,) if axis else default_shard_axes())
-    if not hasattr(program, "_param_shardings"):
-        program._param_shardings = {}
     ndim = None
     blk = program.global_block()
     if blk.has_var(param_name):
@@ -102,12 +71,13 @@ def shard_table(program, param_name: str, axis: Optional[Axes] = None):
         ndim = len(shp) if shp is not None else None
     first = axes[0] if len(axes) == 1 else tuple(axes)
     spec = (first,) + (None,) * ((ndim or 2) - 1)
-    program._param_shardings[param_name] = tuple(spec)
+    # one vocabulary: the spec write (and its _version bump) goes through
+    # tensor_parallel.shard_parameter; only the sparse-path marker is ours
+    tp_mod.shard_parameter(program, param_name, spec)
     tables = getattr(program, "_sharded_tables", None)
     if tables is None:
         tables = program._sharded_tables = {}
     tables[param_name] = axes
-    program._version = getattr(program, "_version", 0) + 1
     return program
 
 
@@ -170,17 +140,31 @@ def table_accumulators(program, pname: str) -> List[str]:
     return sorted(out)
 
 
+# accumulator→param maps are O(vars × sharded params) to build, and the
+# executor asks per state var per compile — cache per (program, version)
+_ACCUM_CACHE: Dict[Tuple[int, int], Dict[str, str]] = {}
+
+
 def _accum_of(program, name: str) -> Optional[str]:
-    """Sharded-table param whose optimizer accumulator `name` is, or
-    None (table_accumulators membership over _sharded_tables)."""
-    tables = getattr(program, "_sharded_tables", None)
-    if not tables:
+    """Sharded param whose optimizer accumulator `name` is, or None
+    (table_accumulators membership over every spec'd param — since the
+    planner, ANY sharded parameter's accumulators follow it, not just
+    `_sharded_tables` entries)."""
+    sharded = set(getattr(program, "_sharded_tables", None) or ())
+    sharded.update(getattr(program, "_param_shardings", None) or ())
+    if not sharded:
         return None
-    for pname in tables:
-        if name.startswith(pname + "_") \
-                and name in table_accumulators(program, pname):
-            return pname
-    return None
+    key = (id(program), getattr(program, "_version", 0))
+    cached = _ACCUM_CACHE.get(key)
+    if cached is None:
+        if len(_ACCUM_CACHE) > 64:
+            _ACCUM_CACHE.clear()
+        cached = {}
+        for pname in sorted(sharded):
+            for aname in table_accumulators(program, pname):
+                cached.setdefault(aname, pname)
+        _ACCUM_CACHE[key] = cached
+    return cached.get(name)
 
 
 def resolve_state_spec(program, name: str):

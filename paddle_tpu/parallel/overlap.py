@@ -41,8 +41,12 @@ Env knobs: `PADDLE_TPU_OVERLAP=1` (default on) gates all three layers;
 plan time); `PADDLE_TPU_OVERLAP_XLA_FLAGS="k=v,k=v"` overrides the
 compiler-option set on any backend (still probe-validated). Per-reason
 `overlap_fallback_total{program,reason}` mirrors fusion_fallback_total:
-sharded_param / missing_grad / sparse_grad / constraint_failed at the
-bucket layer, platform / rejected_options at the compile layer.
+tp_sharded (model-parallel grads, no cross-dp sum to schedule) /
+sharded_param (spec names an axis the mesh lacks) / missing_grad /
+sparse_grad / constraint_failed at the bucket layer, platform /
+rejected_options at the compile layer. Since the planner (ISSUE 15),
+dp/fsdp-sharded params no longer skip: their grads bucket per
+(dtype, spec) group and flush as eager reduce-scatters.
 
 GSPMD attribution caveat: the all-reduce HLO instructions inherit the
 *producer's* op_name metadata (the grad op), not the bucket scope — the
@@ -101,19 +105,47 @@ def count_fallback(program, reason: str, amount: int = 1):
 @dataclass(frozen=True)
 class Bucket:
     """One flush unit: `grads[i]` is the gradient of `params[i]`, all the
-    same declared dtype, total payload <= the plan-time cap. `anchor` is
-    the global-block index of the LAST op producing any member gradient —
-    the executor flushes the bucket right after that op executes."""
+    same declared dtype AND the same parameter spec group, total payload
+    <= the plan-time cap. `anchor` is the global-block index of the LAST
+    op producing any member gradient — the executor flushes the bucket
+    right after that op executes. `spec` is the spec group's entry tuple:
+    empty for replicated params (the pure-dp case, pinned to the
+    replicated sharding = eager all-reduce) and the parameter's own spec
+    for ZeRO/fsdp-sharded ones (pinned to the param spec = eager
+    reduce-scatter)."""
     index: int
     params: Tuple[str, ...]
     grads: Tuple[str, ...]
     dtype: str
     bytes: int
     anchor: int
+    spec: Tuple = ()
 
     @property
     def site(self) -> str:
-        return f"dp_grad_bucket{self.index}"
+        prefix = "_".join(_spec_axes(self.spec)) or "dp"
+        return f"{prefix}_grad_bucket{self.index}"
+
+
+def _spec_axes(spec) -> Tuple[str, ...]:
+    """Sorted axis names a spec tuple shards over (tuple entries like
+    ("fsdp","tp") flattened); () for replicated."""
+    axes = set()
+    for ent in (spec or ()):
+        for a in (ent if isinstance(ent, (tuple, list))
+                  else (ent,) if ent else ()):
+            axes.add(str(a))
+    return tuple(sorted(axes))
+
+
+def _norm_spec(spec) -> Tuple:
+    """Hashable canonical form of a spec tuple (lists -> tuples, trailing
+    Nones stripped) — the bucket group key next to dtype."""
+    out = [tuple(ent) if isinstance(ent, list) else ent
+           for ent in (spec or ())]
+    while out and not out[-1]:
+        out.pop()
+    return tuple(out)
 
 
 class OverlapPlan:
@@ -222,6 +254,8 @@ def _grad_consumer_map(program) -> Dict[str, str]:
 def _build(program) -> Optional[OverlapPlan]:
     import numpy as np
 
+    from . import planner as planner_mod
+
     block = program.global_block()
     pairs = _grad_pairs(program)
     if not pairs:
@@ -232,7 +266,12 @@ def _build(program) -> Optional[OverlapPlan]:
         for name in op.desc.output_arg_names():
             last[name] = i
     specs = getattr(program, "_param_shardings", {})
-    items = []  # (anchor, pname, gname, dtype, nbytes)
+    mesh = getattr(program, "_mesh", None)
+    mesh_axes = set(getattr(mesh, "axis_names", ()) or ())
+    splan = getattr(program, "_sharding_plan", None)
+    model_axes = planner_mod.model_axes(
+        splan.layout if splan is not None else None)
+    items = []  # (anchor, pname, gname, dtype, nbytes, spec)
     for pname, gname in pairs:
         anchor = last.get(gname)
         if anchor is None:
@@ -248,11 +287,23 @@ def _build(program) -> Optional[OverlapPlan]:
             # stays SelectedRows end-to-end on purpose
             count_fallback(program, "sparse_grad_handled")
             continue
-        if specs.get(pname):
-            # tensor/ZeRO-sharded params: their grads are not replicated
-            # partial sums — GSPMD's per-param resharding stays
-            count_fallback(program, "sharded_param")
-            continue
+        spec = _norm_spec(specs.get(pname))
+        if spec:
+            axes = set(_spec_axes(spec))
+            if axes & model_axes:
+                # genuinely model-parallel (tensor-sharded) grad: each
+                # shard holds DIFFERENT values, there is no cross-dp sum
+                # to schedule — GSPMD's per-param resharding stays
+                count_fallback(program, "tp_sharded")
+                continue
+            if axes - mesh_axes:
+                # spec names an axis this mesh doesn't have — can't pin
+                # to it; keep the historical reason for dashboards
+                count_fallback(program, "sharded_param")
+                continue
+            # dp/fsdp spec group: the grad IS a cross-dp sum; pinning it
+            # to the param's spec is an eager reduce-scatter — bucketed
+            # below per (dtype, spec) group
         try:
             var = block.var(gname) if block.desc.has_var(gname) \
                 else block.var(pname)
@@ -266,7 +317,7 @@ def _build(program) -> Optional[OverlapPlan]:
             continue
         nbytes = int(np.prod(shape, dtype=np.int64)) * _dtype_nbytes(dtype) \
             if shape else _dtype_nbytes(dtype)
-        items.append((anchor, pname, gname, dtype, nbytes))
+        items.append((anchor, pname, gname, dtype, nbytes, spec))
     if not items:
         return None
     # readiness order: ascending last-producer index = the order backward
@@ -274,43 +325,51 @@ def _build(program) -> Optional[OverlapPlan]:
     items.sort(key=lambda it: (it[0], it[2]))
     cap = _bucket_cap_bytes()
     buckets: List[Bucket] = []
-    open_by_dtype: Dict[str, List[Any]] = {}  # dtype -> [params, grads, bytes, anchor]
+    # (dtype, spec) group -> [params, grads, bytes, anchor]: grads only
+    # bucket with grads that pin to the SAME sharding, so a replicated
+    # fc bias never rides an fsdp weight's reduce-scatter bucket
+    open_by_group: Dict[Tuple[str, Tuple], List[Any]] = {}
 
-    def _close(dtype):
-        acc = open_by_dtype.pop(dtype, None)
+    def _close(group):
+        acc = open_by_group.pop(group, None)
         if acc:
+            dtype, spec = group
             buckets.append(Bucket(
                 index=len(buckets), params=tuple(acc[0]),
                 grads=tuple(acc[1]), dtype=dtype, bytes=acc[2],
-                anchor=acc[3]))
+                anchor=acc[3], spec=spec))
 
-    for anchor, pname, gname, dtype, nbytes in items:
-        acc = open_by_dtype.get(dtype)
+    for anchor, pname, gname, dtype, nbytes, spec in items:
+        group = (dtype, spec)
+        acc = open_by_group.get(group)
         if acc is not None and acc[2] + nbytes > cap:
-            _close(dtype)
+            _close(group)
             acc = None
         if acc is None:
-            acc = open_by_dtype[dtype] = [[], [], 0, anchor]
+            acc = open_by_group[group] = [[], [], 0, anchor]
         acc[0].append(pname)
         acc[1].append(gname)
         acc[2] += nbytes
         acc[3] = max(acc[3], anchor)
-    # deterministic close order for the stragglers: by dtype name
-    for dtype in sorted(open_by_dtype):
-        _close(dtype)
+    # deterministic close order for the stragglers: by group key
+    for group in sorted(open_by_group, key=repr):
+        _close(group)
     buckets.sort(key=lambda b: (b.anchor, b.index))
     # re-number in anchor order so site indices follow flush order
     buckets = [Bucket(index=i, params=b.params, grads=b.grads,
-                      dtype=b.dtype, bytes=b.bytes, anchor=b.anchor)
+                      dtype=b.dtype, bytes=b.bytes, anchor=b.anchor,
+                      spec=b.spec)
                for i, b in enumerate(buckets)]
     return OverlapPlan(buckets)
 
 
 def _flush(ctx, bucket: Bucket, env: Dict[str, Any]):
-    """Pin every dense member gradient to the replicated sharding under
-    the bucket's pd.coll scope. Pure annotation — the constrained value
-    is the same value, so the trace stays bitwise identical; only WHERE
-    the partitioner resolves the cross-device sum moves."""
+    """Pin every dense member gradient to the bucket's spec-group
+    sharding — replicated for the pure-dp group (eager all-reduce), the
+    param's own dp/fsdp spec for a ZeRO group (eager reduce-scatter) —
+    under the bucket's pd.coll scope. Pure annotation — the constrained
+    value is the same value, so the trace stays bitwise identical; only
+    WHERE the partitioner resolves the cross-device sum moves."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
@@ -321,7 +380,11 @@ def _flush(ctx, bucket: Bucket, env: Dict[str, Any]):
     mesh = getattr(program, "_mesh", None)
     if mesh is None:
         return
-    repl = NamedSharding(mesh, PartitionSpec())
+    try:
+        repl = NamedSharding(mesh, PartitionSpec(*bucket.spec))
+    except (TypeError, ValueError):
+        count_fallback(program, "constraint_failed")
+        return
     emitted = 0
     with coll_scope(bucket.site):
         for gname in bucket.grads:

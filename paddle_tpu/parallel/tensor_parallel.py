@@ -35,8 +35,15 @@ def _specs(program) -> Dict[str, Tuple]:
 def shard_parameter(program, param_name: str, spec: Sequence[Optional[str]]):
     """Annotate one parameter with a PartitionSpec (dims -> mesh axis or
     None). The executor turns this into an in_sharding for the jitted
-    train step; XLA propagates it through every consumer."""
-    _specs(program)[param_name] = tuple(spec)
+    train step; XLA propagates it through every consumer. This is THE
+    spec write path — planner.plan and embedding.shard_table both route
+    through here — so the _version bump that invalidates compiled-step
+    and overlap-plan caches lives here and nowhere else."""
+    specs = _specs(program)
+    spec = tuple(spec)
+    if specs.get(param_name) != spec:
+        specs[param_name] = spec
+        program._version = getattr(program, "_version", 0) + 1
     return program
 
 

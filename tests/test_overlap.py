@@ -183,13 +183,43 @@ class TestPlan:
         prog = self._program()
         assert _with_overlap(False, overlap.plan, prog) is None
 
-    def test_sharded_param_falls_back(self):
+    def test_tp_sharded_param_falls_back(self):
+        """Model-parallel (tensor-sharded) grads hold different values
+        per shard — no cross-dp sum to schedule, counted tp_sharded."""
+        prog = self._program()
+        some_param = prog.global_block().all_parameters()[0].name
+        prog._param_shardings = {some_param: (None, "mp")}
+        p = overlap._build(prog)
+        assert _fallbacks("tp_sharded") == 1
+        assert all(some_param not in b.params for b in p.buckets)
+
+    def test_unknown_axis_param_keeps_sharded_param_reason(self):
+        """A spec naming an axis this mesh lacks can't be pinned — the
+        historical sharded_param reason stays for dashboards."""
+        prog = self._program()
+        some_param = prog.global_block().all_parameters()[0].name
+        prog._param_shardings = {some_param: ("fsdp", None)}  # dp-only mesh
+        p = overlap._build(prog)
+        assert _fallbacks("sharded_param") == 1
+        assert all(some_param not in b.params for b in p.buckets)
+
+    def test_dp_sharded_param_buckets_per_spec_group(self):
+        """ISSUE 15: a ZeRO/dp-sharded param no longer skips — its grad
+        buckets in its OWN (dtype, spec) group, never mixed with
+        replicated grads, and the bucket records the spec to pin to."""
         prog = self._program()
         some_param = prog.global_block().all_parameters()[0].name
         prog._param_shardings = {some_param: ("dp", None)}
         p = overlap._build(prog)
-        assert _fallbacks("sharded_param") == 1
-        assert all(some_param not in b.params for b in p.buckets)
+        assert _fallbacks("sharded_param") == 0
+        assert _fallbacks("tp_sharded") == 0
+        with_param = [b for b in p.buckets if some_param in b.params]
+        assert len(with_param) == 1
+        assert with_param[0].spec == ("dp",)
+        # replicated grads keep the empty spec and never share a bucket
+        for b in p.buckets:
+            if some_param not in b.params:
+                assert b.spec == ()
 
 
 class TestFlushFallbacks:

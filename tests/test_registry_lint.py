@@ -232,6 +232,57 @@ def test_serving_lint_catches_unregistered_op(monkeypatch):
                for _, m in problems), problems
 
 
+def test_planner_roles_consistent():
+    """ISSUE 15 satellite: the sharding planner's vocabulary stays one
+    vocabulary — every classifier-table op registered, SPEC_ROLES ==
+    producible ROLES in both directions, and embedding.py's table specs
+    agreeing with the planner's `embedding` role (SpecLayout identity +
+    shard_table writing role_spec('embedding', 2))."""
+    problems = _load_checker().check_planner_roles()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_planner_lint_catches_drift(monkeypatch):
+    """Sanity in three directions: an unregistered op in a classifier
+    table, a spec-table role no rule produces, and a producible role the
+    spec table doesn't know."""
+    from paddle_tpu.parallel import planner
+
+    checker = _load_checker()
+    orig_transparent = planner.TRANSPARENT_OPS
+    monkeypatch.setattr(
+        planner, "TRANSPARENT_OPS",
+        orig_transparent | {"definitely_not_an_op"})
+    problems = checker.check_planner_roles()
+    assert any("definitely_not_an_op" in m for _, m in problems), problems
+
+    monkeypatch.setattr(planner, "TRANSPARENT_OPS", orig_transparent)
+    monkeypatch.setattr(planner, "SPEC_ROLES",
+                        planner.SPEC_ROLES | {"bogus_role"})
+    problems = checker.check_planner_roles()
+    assert any("bogus_role" in m and "no classifier rule" in m
+               for _, m in problems), problems
+
+    monkeypatch.setattr(planner, "SPEC_ROLES",
+                        planner.SPEC_ROLES - {"bogus_role", "ffn_down"})
+    problems = checker.check_planner_roles()
+    assert any("ffn_down" in m and "SPEC_ROLES" in m
+               for _, m in problems), problems
+
+
+def test_planner_lint_catches_embedding_divergence(monkeypatch):
+    """Sanity: an embedding.py table spec diverging from the planner's
+    embedding role (the second-vocabulary regression) trips the lint."""
+    from paddle_tpu.parallel import embedding, planner
+
+    checker = _load_checker()
+    monkeypatch.setattr(
+        planner.SpecLayout, "embeddings",
+        lambda self: (self.fsdp_axis, None))
+    problems = checker.check_planner_roles()
+    assert any("embedding" in w for w, _ in problems), problems
+
+
 def test_cli_passes():
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
     r = subprocess.run(

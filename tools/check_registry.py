@@ -448,6 +448,93 @@ def check_serving_programs():
     return problems
 
 
+def check_planner_roles():
+    """[(where, msg)] pinning the sharding planner's vocabulary (ISSUE 15
+    satellite) — one data x fsdp x tp vocabulary, no drift:
+
+      * every op the classifier tables name (OP_INPUT_ROLES keys,
+        TRANSPARENT_OPS, ATTENTION_OPS, HEAD_OPS, MATMUL_OPS) is
+        registered — a typo'd op never raises, the rule just silently
+        stops matching;
+      * SPEC_ROLES == ROLES in both directions: a role the spec table
+        distinguishes but no classifier rule produces is dead code, and
+        a classifier role the spec table doesn't know silently falls
+        into the replicated default;
+      * embedding.py agrees with the planner's `embedding` role: its
+        SpecLayout IS the planner's class (re-export, not a copy) and
+        shard_table's written spec for a default-axes 2-D table matches
+        `role_spec("embedding", 2)` — the second vocabulary staying gone.
+    """
+    from paddle_tpu.ops import registry
+    from paddle_tpu.parallel import embedding, planner
+
+    registered = set(registry.registered_ops())
+    problems = []
+
+    tables = {
+        "planner.OP_INPUT_ROLES":
+            sorted({op for (op, _slot) in planner.OP_INPUT_ROLES}),
+        "planner.TRANSPARENT_OPS": sorted(planner.TRANSPARENT_OPS),
+        "planner.ATTENTION_OPS": sorted(planner.ATTENTION_OPS),
+        "planner.HEAD_OPS": sorted(planner.HEAD_OPS),
+        "planner.MATMUL_OPS": sorted(planner.MATMUL_OPS),
+    }
+    for tname in sorted(tables):
+        for name in tables[tname]:
+            base = name[:-5] if name.endswith("_grad") else name
+            if base not in registered:
+                problems.append(
+                    (tname, f"names op '{name}', which is not registered "
+                            f"in ops/registry.py"))
+
+    for role in sorted(planner.SPEC_ROLES - planner.ROLES):
+        problems.append(
+            ("planner.SPEC_ROLES",
+             f"role '{role}' has a spec but no classifier rule produces "
+             f"it (not in OP_INPUT_ROLES values or WALK_ROLES)"))
+    for role in sorted(planner.ROLES - planner.SPEC_ROLES):
+        problems.append(
+            ("planner.ROLES",
+             f"classifier role '{role}' is missing from SPEC_ROLES — "
+             f"role_spec silently replicates it"))
+
+    if embedding.SpecLayout is not planner.SpecLayout:
+        problems.append(
+            ("embedding.SpecLayout",
+             "is not planner.SpecLayout — a second spec vocabulary "
+             "crept back"))
+    layout = planner.SpecLayout()
+    if tuple(layout.embeddings()) != tuple(layout.role_spec("embedding", 2)):
+        problems.append(
+            ("embedding role",
+             f"SpecLayout.embeddings() {layout.embeddings()} != "
+             f"role_spec('embedding', 2) "
+             f"{layout.role_spec('embedding', 2)}"))
+    # shard_table writes what the planner would: synthesize a program
+    # with one 2-D table and compare channels
+    import paddle_tpu as pd
+    from paddle_tpu.framework import unique_name
+    with unique_name.guard():
+        prog = pd.Program()
+        start = pd.Program()
+        with pd.program_guard(prog, start):
+            import paddle_tpu.layers as pd_layers
+            ids = pd_layers.data(name="_lint_ids", shape=[1], dtype="int64")
+            pd_layers.embedding(input=ids, size=[16, 4])
+        tables = embedding.shard_embeddings(
+            prog, mesh=None, layout=layout,
+            axis=(layout.fsdp_axis, layout.tensor_axis))
+        for t in tables:
+            wrote = tuple((prog._param_shardings or {}).get(t) or ())
+            want = tuple(layout.role_spec("embedding", 2))
+            if wrote != want:
+                problems.append(
+                    ("embedding.shard_table",
+                     f"wrote spec {wrote} for '{t}' but the planner's "
+                     f"embedding role says {want}"))
+    return problems
+
+
 def main():
     problems = check_tables()
     for tname, name in problems:
@@ -473,8 +560,11 @@ def main():
     servp = check_serving_programs()
     for where, msg in servp:
         print(f"{where}: {msg}")
+    plroles = check_planner_roles()
+    for where, msg in plroles:
+        print(f"{where}: {msg}")
     problems = problems + coll + jit + sparse + embc + pallas + inferp \
-        + servp
+        + servp + plroles
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")

@@ -33,6 +33,17 @@ only a budget-sized slab is device-resident, and the lines add
 cache_rows / cache_hit_rate / prefetch_overlap_fraction /
 flush_bytes_per_step (null when the cache is off).
 
+SCALE_MODEL=lm swaps in the planner-sharded transformer LM (ISSUE 15):
+each mesh size is factored into data x fsdp x tp named axes
+(SCALE_LM_TP picks the tp degree, default 2 when it divides) and
+`paddle_tpu.parallel.planner.plan` writes every spec — no hand
+annotation. Its per-mesh lines always carry `param_bytes_per_shard`
+(per-device param HBM under the plan — falls as fsdp x tp grows),
+`overlap_fraction` and `busbw` (null when the trace shows no
+collectives, e.g. 1-device runs). SCALE_LM_VOCAB / SCALE_LM_DMODEL /
+SCALE_LM_LAYERS / SCALE_LM_SEQLEN size the model (defaults are a smoke
+config; scale them up on a real slice).
+
 On a CPU host it exercises the identical GSPMD path over virtual devices
 — mechanism check only; the shared core makes the timings say nothing
 about ICI. Use SCALE_PLATFORM=cpu (the env var JAX_PLATFORMS alone does
@@ -139,7 +150,7 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
     from paddle_tpu.framework import unique_name
 
     batch = per_device_batch * n_devices
-    emb_cfg = None
+    emb_cfg = lm_cfg = None
     with unique_name.guard():
         main, startup = fluid.Program(), fluid.Program()
         rng = np.random.default_rng(0)
@@ -185,6 +196,47 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
             x = rng.integers(0, emb_cfg["rows"],
                              (batch, emb_cfg["slots"])).astype(np.int64)
             y = rng.integers(0, 2, (batch, 1)).astype(np.int64)
+        elif model_name == "lm":
+            # planner-sharded LM family (ISSUE 15): the mesh size under
+            # test is factored into data x fsdp x tp named axes and every
+            # spec comes from planner.plan's role classification — the
+            # sweep shows param_bytes_per_shard falling with fsdp x tp
+            # while the planned collectives stay hidden (overlap_fraction)
+            lm_cfg = {
+                "vocab": int(os.environ.get("SCALE_LM_VOCAB", "512")),
+                "d_model": int(os.environ.get("SCALE_LM_DMODEL", "64")),
+                "layers": int(os.environ.get("SCALE_LM_LAYERS", "2")),
+                "seqlen": int(os.environ.get("SCALE_LM_SEQLEN", "64"))}
+            # feeds reuse the sweep's img/label plumbing (ids-as-img, like
+            # the embedding family)
+            with fluid.program_guard(main, startup):
+                tok = fluid.layers.data(name="img",
+                                        shape=[lm_cfg["seqlen"]],
+                                        dtype="int64")
+                lab = fluid.layers.data(name="label",
+                                        shape=[lm_cfg["seqlen"]],
+                                        dtype="int64")
+                avg_cost = models.transformer_lm(
+                    tok, lab, vocab_size=lm_cfg["vocab"],
+                    d_model=lm_cfg["d_model"], n_head=4,
+                    n_layer=lm_cfg["layers"])
+                fluid.optimizer.Momentum(learning_rate=0.01,
+                                         momentum=0.9).minimize(
+                    avg_cost, startup_program=startup)
+            if n_devices > 1:
+                from paddle_tpu.parallel import planner as planner_mod
+                tp = int(os.environ.get("SCALE_LM_TP", "2"))
+                tp = tp if tp > 0 and n_devices % tp == 0 else 1
+                rest = n_devices // tp
+                fsdp = 2 if rest % 2 == 0 else 1
+                dp = rest // fsdp
+                mesh = Mesh(np.array(jax.devices()[:n_devices]).reshape(
+                    dp, fsdp, tp), ("dp", "fsdp", "tp"))
+                planner_mod.plan(main, mesh)
+            x = rng.integers(0, lm_cfg["vocab"],
+                             (batch, lm_cfg["seqlen"])).astype(np.int64)
+            y = rng.integers(0, lm_cfg["vocab"],
+                             (batch, lm_cfg["seqlen"])).astype(np.int64)
         else:
             with fluid.program_guard(main, startup):
                 img = fluid.layers.data(name="img", shape=[3, 32, 32],
@@ -270,6 +322,13 @@ def measure(n_devices, steps=None, warmup=None, per_device_batch=None,
                     main, emb_cfg, batch * steps / dt))
                 perf.update(_emb_cache_fields(emb_cache, cache_base,
                                               steps))
+            if lm_cfg is not None:
+                # lm lines always carry the three planner columns;
+                # overlap_fraction/busbw stay whatever the trace showed
+                # (null when it had no collectives — 1-device runs)
+                perf.update(_lm_fields(main))
+                perf.setdefault("overlap_fraction", None)
+                perf.setdefault("busbw", None)
             perf.update(_analyze_fields(main))
     assert np.isfinite(final)
     return batch * steps / dt, peak_hbm, perf, k
@@ -382,6 +441,21 @@ def _analyze_fields(main):
     except Exception as e:  # noqa: BLE001 - advisory, never kills the line
         print(f"static analysis skipped: {e}", file=sys.stderr)
         return {}
+
+
+def _lm_fields(main):
+    """Planner columns for the lm family: per-device parameter HBM under
+    the written specs (`memory.per_shard_param_bytes` — the same number
+    planner.validate_plan_bytes pins the plan against), null if the
+    accounting fails. The 1-device run has no plan, so the column reads
+    the full replicated footprint — the sweep's falling trend starts
+    from it."""
+    try:
+        from paddle_tpu.parallel import per_shard_param_bytes
+        return {"param_bytes_per_shard":
+                per_shard_param_bytes(main)["per_device_bytes"]}
+    except Exception:  # noqa: BLE001 - bytes column is best-effort
+        return {"param_bytes_per_shard": None}
 
 
 def _embedding_fields(main, emb_cfg, examples_per_sec):
