@@ -1342,6 +1342,7 @@ def main_serving():
     finally:
         batcher.stop()
     densify = telemetry.read_series("sparse_densify_fallback_total")
+    slo_report = batcher.slo_monitor.report()
     _emit({
         "metric": "serving_p50_ms",
         "value": normal["p50_ms"],
@@ -1352,9 +1353,12 @@ def main_serving():
         "shed_fraction": normal["shed_fraction"],
         "bucket_hits": normal["bucket_hits"],
         "goodput_fraction": normal["goodput_fraction"],
+        "timeouts": normal["timeouts"] + overload["timeouts"],
         "overload": {k: overload[k] for k in
                      ("p50_ms", "p99_ms", "qps", "shed_fraction",
                       "bucket_hits", "goodput_fraction")},
+        "slo_burn_fast": slo_report["windows"]["fast"]["burn_rate"],
+        "slo_burn_slow": slo_report["windows"]["slow"]["burn_rate"],
         "model": model, "clients": clients, "max_batch": max_batch,
         "compile_cache": {"hits": engine.cache_hits,
                           "misses": engine.cache_misses},

@@ -51,9 +51,12 @@ from . import concurrency
 from .concurrency import (make_channel, channel_send, channel_recv,
                           channel_close, Go, Select)
 from . import telemetry
+from . import tracing
 from . import serving
 from . import inspector
 from . import roofline
+from . import obs_server
+obs_server.maybe_start_from_env()
 from .parallel import transpiler
 from .parallel.transpiler import DistributeTranspiler
 

@@ -9,6 +9,7 @@ Usage:
     python -m paddle_tpu infer --model-dir=D --input=batch.npz
     python -m paddle_tpu telemetry [--log step.jsonl [--tail N]]
                                    [--prometheus] [--reduce]
+    python -m paddle_tpu obs [--port P] [--steps N] [--hold]
     python -m paddle_tpu version
 
 The config file is a Python module (the reference's --config was a Python
@@ -621,6 +622,74 @@ def cmd_serve(args):
     return 0
 
 
+def cmd_obs(args):
+    """Live observability plane smoke: start the scrapeable HTTP server
+    (obs_server.py), enable request/step tracing, run a small training
+    loop so the endpoints have live data, then self-scrape /metrics,
+    /healthz and /spans over real HTTP and print one JSON summary line.
+    With --hold the server keeps running after the loop (Ctrl-C exits) so
+    an external Prometheus/curl can scrape a long-lived process."""
+    import http.client
+    import json
+
+    import paddle_tpu as fluid
+    from paddle_tpu import executor as executor_mod
+    from paddle_tpu import memory, obs_server, tracing
+
+    if not args.no_trace:
+        tracing.enable()
+    srv = obs_server.start(port=args.port)
+    print(f"obs: serving http://127.0.0.1:{srv.port} "
+          f"(/metrics /healthz /spans /report)", file=sys.stderr)
+
+    with executor_mod.scope_guard(executor_mod.Scope()):
+        spec = memory.build_smoke(args.smoke)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(spec["startup"])
+        feed = spec["data_fn"](args.batch)
+        for _ in range(args.steps):
+            exe.run(spec["main"], feed=feed, fetch_list=[spec["loss"]])
+            if args.interval_ms:
+                time_mod.sleep(args.interval_ms / 1000.0)
+
+    def get(route):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        try:
+            conn.request("GET", route)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    st_metrics, metrics_body = get("/metrics")
+    _st_health, health_body = get("/healthz")
+    st_spans, spans_body = get("/spans?n=8")
+    summary = {
+        "port": srv.port,
+        "steps": args.steps,
+        "metrics": {"status": st_metrics, "bytes": len(metrics_body)},
+        "healthz": json.loads(health_body),
+        "spans": {"status": st_spans,
+                  "returned": len(json.loads(spans_body)["spans"]),
+                  "buffered": len(tracing.recent_spans())},
+    }
+    if args.export_trace:
+        n = tracing.export_chrome_trace(args.export_trace)
+        summary["chrome_trace"] = {"path": args.export_trace,
+                                   "events": n}
+    print(json.dumps(summary, sort_keys=True, default=str))
+    if args.hold:
+        print("obs: holding — Ctrl-C to exit", file=sys.stderr)
+        try:
+            while True:
+                time_mod.sleep(1.0)
+        except KeyboardInterrupt:
+            pass
+    obs_server.stop()
+    return 0 if st_metrics == 200 and st_spans == 200 else 1
+
+
 def cmd_version(_args):
     import paddle_tpu
     import jax
@@ -954,6 +1023,34 @@ def main(argv=None):
                        help="per-request deadline; expired requests are "
                             "shed instead of executed (default none)")
     p_srv.set_defaults(fn=cmd_serve)
+
+    p_obs = sub.add_parser(
+        "obs", help="live observability plane: scrapeable /metrics "
+                    "/healthz /spans /report HTTP server + traced "
+                    "training smoke; prints one JSON summary line")
+    p_obs.add_argument("--port", type=int,
+                       default=int(os.environ.get("PADDLE_TPU_OBS_PORT")
+                                   or 0),
+                       help="bind port (default $PADDLE_TPU_OBS_PORT "
+                            "or 0 = ephemeral)")
+    p_obs.add_argument("--smoke", default="fit_a_line",
+                       help="smoke program driving the live data "
+                            "(fit_a_line or resnet; default fit_a_line)")
+    p_obs.add_argument("--steps", type=int, default=20,
+                       help="smoke steps to run (default 20)")
+    p_obs.add_argument("--batch", type=int, default=16,
+                       help="smoke batch size (default 16)")
+    p_obs.add_argument("--interval-ms", type=float, default=0.0,
+                       help="sleep between smoke steps in ms (default 0)")
+    p_obs.add_argument("--no-trace", action="store_true",
+                       help="leave span tracing off (default: enabled "
+                            "for the smoke)")
+    p_obs.add_argument("--export-trace", default=None,
+                       help="write the span ring as chrome-trace JSON "
+                            "here before exiting")
+    p_obs.add_argument("--hold", action="store_true",
+                       help="keep serving after the smoke until Ctrl-C")
+    p_obs.set_defaults(fn=cmd_obs)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

@@ -32,6 +32,7 @@ import numpy as np
 from . import flags as flags_mod
 from . import memory as memory_mod
 from . import telemetry
+from . import tracing as tracing_mod
 from .framework.desc import VarType
 from .framework.framework import Program, Variable, default_main_program
 from .ops import registry
@@ -949,6 +950,20 @@ class Executor:
             execute_s=max(run_dt - compile_s, 0.0), cache=cache_status,
             donated=len(state_vals), feeds=len(feed_vals),
             fetches=len(fetch_names))
+        if tracing_mod.enabled():
+            # retroactive window span from the wall time already measured
+            # (perf_counter and monotonic share CLOCK_MONOTONIC on linux;
+            # we re-anchor on monotonic to keep one trace timebase)
+            t_end = time.monotonic()
+            sp = tracing_mod.record_span(
+                "run_steps_window", t_end - run_dt, t_end,
+                attrs={"program": prog_label, "place": place_label,
+                       "steps": steps, "cache": cache_status})
+            if new_sig and compile_s > 0.0:
+                tracing_mod.record_span(
+                    "compile", t_end - run_dt,
+                    min(t_end - run_dt + compile_s, t_end), parent=sp,
+                    attrs={"cause": cause, "seconds": compile_s})
 
         hbm_sample = None
         try:
@@ -1342,6 +1357,17 @@ class Executor:
             seconds=run_dt, compile_s=compile_s,
             execute_s=max(run_dt - compile_s, 0.0), cache=cache_status,
             donated=donated, feeds=len(feed_vals), fetches=n_user_fetch)
+        if tracing_mod.enabled():
+            t_end = time.monotonic()
+            sp = tracing_mod.record_span(
+                "step", t_end - run_dt, t_end,
+                attrs={"program": prog_label, "place": place_label,
+                       "mode": mode, "cache": cache_status})
+            if compile_s > 0.0 and cache_status == "miss":
+                tracing_mod.record_span(
+                    "compile", t_end - run_dt,
+                    min(t_end - run_dt + compile_s, t_end), parent=sp,
+                    attrs={"seconds": compile_s})
 
         hbm_sample = None
         if not internal_run:

@@ -797,7 +797,7 @@ def notify_crash(exe, program, exc) -> Optional[str]:
     enabled) and return its path. EOFException is the reader drain-loop's
     normal end-of-pass signal, not a crash."""
     global _IN_CRASH
-    if not flight_enabled() or _IN_CRASH:
+    if _IN_CRASH:
         return None
     if getattr(program, "_inspector_internal", False):
         return None
@@ -807,6 +807,17 @@ def notify_crash(exe, program, exc) -> Optional[str]:
             return None
     except Exception:
         pass
+    try:
+        # step-event record regardless of the flight recorder so
+        # /healthz (obs_server) can report a last-error verdict even in
+        # processes that never enabled crash dumps
+        telemetry.log_event(
+            "crash", error=f"{type(exc).__name__}: {exc}",
+            program=telemetry.program_label(program))
+    except Exception:
+        pass
+    if not flight_enabled():
+        return None
     _IN_CRASH = True
     try:
         telemetry.counter(

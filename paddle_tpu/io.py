@@ -102,6 +102,13 @@ def _record_checkpoint(op: str, dirname: str, nbytes: int, n_vars: int,
         if seconds is not None:
             fields["seconds"] = seconds
         telemetry.log_event(f"checkpoint_{op}", **fields)
+        from . import tracing
+        if tracing.enabled() and seconds is not None:
+            t_end = time.monotonic()
+            tracing.record_span(
+                f"checkpoint_{op}", t_end - seconds, t_end,
+                attrs={"dirname": dirname, "bytes": nbytes,
+                       "vars": n_vars})
     except Exception:
         pass
 

@@ -1,0 +1,275 @@
+"""Scrapeable live-observability endpoint (stdlib-only HTTP server).
+
+A daemon-thread `ThreadingHTTPServer` that exposes the process's
+telemetry surface while a training or serving workload runs in the
+foreground threads:
+
+    /metrics   Prometheus text exposition of the whole registry
+    /healthz   liveness verdict: 200 JSON when healthy, 503 when steps
+               have stalled (no run/run_window step event within the
+               staleness threshold) or a crash event was recorded;
+               "degraded" (still 200) when any model's fast-window SLO
+               burn rate exceeds 1.0
+    /spans     recent finished trace spans (tracing.py ring buffer);
+               ?n= limits, ?trace_id= filters, ?name= filters
+    /report    roofline/fleet/SLO JSON roll-up
+    /          endpoint index
+
+Enable with `PADDLE_TPU_OBS_PORT=<port>` (picked up at import via
+`maybe_start_from_env`), programmatically via `start(port=...)`, or with
+the `python -m paddle_tpu obs` CLI subcommand. Port 0 binds an ephemeral
+port (tests); the bound port is `server.port`.
+
+The health verdict is deliberately conservative about silence: a process
+that never ran a step (a pure serving process, say) is healthy — only a
+process that *was* stepping and stopped inside the staleness threshold
+flips to 503. The threshold defaults to max(60 s, 20x the last step's
+wall time) and can be overridden per scrape with `?max_age=<seconds>`
+(how the stall test flips it without waiting a minute).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import telemetry
+from . import tracing
+
+_LOCK = threading.Lock()
+_SERVER: Optional["ObsServer"] = None
+
+DEFAULT_MAX_STEP_AGE_S = 60.0
+STEP_AGE_MULTIPLIER = 20.0
+
+
+def health_report(max_step_age_s: Optional[float] = None,
+                  now: Optional[float] = None) -> Dict[str, object]:
+    """The /healthz verdict as a dict: {"status": "ok"|"degraded"|
+    "unhealthy", "healthy": bool, "checks": {...}}. Pure function of the
+    telemetry event ring + SLO registry so it is testable without HTTP."""
+    now = time.time() if now is None else now
+    last_step = None
+    crash = None
+    for ev in reversed(telemetry.recent_events()):
+        kind = ev.get("kind")
+        if last_step is None and kind in ("run", "run_window"):
+            last_step = ev
+        if crash is None and kind == "crash":
+            crash = ev
+        if last_step is not None and crash is not None:
+            break
+
+    checks: Dict[str, object] = {}
+    healthy = True
+    if last_step is None:
+        # never stepped: not a training process, silence is not a stall
+        checks["step"] = {"ran": False, "stalled": False}
+    else:
+        age = max(now - float(last_step.get("ts", now)), 0.0)
+        last_s = telemetry.read_gauge("executor_last_step_seconds")
+        threshold = (float(max_step_age_s) if max_step_age_s is not None
+                     else max(DEFAULT_MAX_STEP_AGE_S,
+                              STEP_AGE_MULTIPLIER * (last_s or 0.0)))
+        stalled = age > threshold
+        checks["step"] = {"ran": True, "age_s": age,
+                          "threshold_s": threshold, "stalled": stalled,
+                          "last_step_seconds": last_s}
+        if stalled:
+            healthy = False
+    if crash is not None:
+        checks["last_error"] = {"error": crash.get("error"),
+                                "program": crash.get("program"),
+                                "ts": crash.get("ts")}
+        healthy = False
+    else:
+        checks["last_error"] = None
+
+    degraded = False
+    try:
+        from .serving import slo as slo_mod
+        slo_reports = slo_mod.all_reports()
+        burns = {model: {w: r["windows"][w]["burn_rate"]
+                         for w in ("fast", "slow")}
+                 for model, r in slo_reports.items()}
+        degraded = any(b["fast"] > 1.0 for b in burns.values())
+        checks["slo"] = {"burn_rates": burns, "burning": degraded}
+    except Exception:
+        checks["slo"] = None
+
+    status = ("unhealthy" if not healthy
+              else "degraded" if degraded else "ok")
+    return {"status": status, "healthy": healthy, "checks": checks}
+
+
+def _report_payload() -> Dict[str, object]:
+    """/report: roll up the post-hoc reporters that exist in-process."""
+    out: Dict[str, object] = {}
+    try:
+        from .serving import slo as slo_mod
+        out["slo"] = slo_mod.all_reports()
+    except Exception:
+        out["slo"] = None
+    try:
+        from . import fleet
+        out["goodput"] = fleet.goodput_report()
+    except Exception:
+        out["goodput"] = None
+    # the roofline reporter publishes its headline numbers as gauges
+    # (roofline.collect_report side effect); scrape those rather than
+    # re-running a trace collection on a live process
+    roofline_gauges = {}
+    for gname in ("mfu_nominal", "mfu_vs_sustained",
+                  "device_duty_cycle"):
+        v = telemetry.read_gauge(gname)
+        if v is not None:
+            roofline_gauges[gname] = v
+    out["roofline"] = roofline_gauges or None
+    snap = telemetry.snapshot()
+    out["metrics_families"] = len(snap)
+    out["spans_buffered"] = len(tracing.recent_spans())
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-obs/1.0"
+
+    # silence per-request stderr lines — scrapes are periodic
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj):
+        self._send(code, json.dumps(obj, default=str).encode(),
+                   "application/json")
+
+    def do_GET(self):
+        parsed = urlparse(self.path)
+        route = parsed.path.rstrip("/") or "/"
+        q = parse_qs(parsed.query)
+        telemetry.counter(
+            "obs_requests_total", "observability endpoint scrapes",
+            labels=("endpoint",)).labels(endpoint=route).inc()
+        try:
+            if route == "/metrics":
+                text = telemetry.prometheus_text(telemetry.snapshot())
+                self._send(200, text.encode(),
+                           "text/plain; version=0.0.4")
+            elif route == "/healthz":
+                max_age = q.get("max_age", [None])[0]
+                rep = health_report(
+                    max_step_age_s=float(max_age)
+                    if max_age is not None else None)
+                self._send_json(200 if rep["healthy"] else 503, rep)
+            elif route == "/spans":
+                n = q.get("n", [None])[0]
+                spans = tracing.recent_spans(
+                    n=int(n) if n is not None else None,
+                    name=q.get("name", [None])[0],
+                    trace_id=q.get("trace_id", [None])[0])
+                self._send_json(200, {"spans": spans,
+                                      "enabled": tracing.enabled()})
+            elif route == "/report":
+                self._send_json(200, _report_payload())
+            elif route == "/":
+                self._send_json(200, {"endpoints": [
+                    "/metrics", "/healthz", "/spans", "/report"]})
+            else:
+                self._send_json(404, {"error": f"no route {route}"})
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # a scrape must never kill the server
+            try:
+                self._send_json(500,
+                                {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+
+class ObsServer:
+    """Background observability server. `start()` binds and spawns the
+    daemon serve thread; `port` is the actually-bound port (useful with
+    port=0)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._requested_port = int(port)
+        self.host = host
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return (self._httpd.server_address[1] if self._httpd is not None
+                else None)
+
+    def start(self) -> "ObsServer":
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="paddle-tpu-obs",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def url(self, route: str = "/") -> str:
+        return f"http://{self.host}:{self.port}{route}"
+
+
+def start(port: int = 0, host: str = "127.0.0.1") -> ObsServer:
+    """Start (or return) the process-wide observability server."""
+    global _SERVER
+    with _LOCK:
+        if _SERVER is None:
+            _SERVER = ObsServer(port=port, host=host).start()
+        return _SERVER
+
+
+def stop():
+    global _SERVER
+    with _LOCK:
+        if _SERVER is not None:
+            _SERVER.stop()
+            _SERVER = None
+
+
+def active() -> Optional[ObsServer]:
+    return _SERVER
+
+
+def maybe_start_from_env() -> Optional[ObsServer]:
+    """Honor PADDLE_TPU_OBS_PORT: a port number starts the server on
+    import (0 = ephemeral). Unset/empty/invalid leaves it off."""
+    import os
+    raw = os.environ.get("PADDLE_TPU_OBS_PORT", "").strip()
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        return None
+    try:
+        return start(port=port)
+    except OSError:
+        return None

@@ -14,14 +14,19 @@ Three layers, composed bottom-up:
   * harness.py — concurrent-client load generator reporting
     p50/p99/qps/bucket-hits/goodput; backs `BENCH_MODE=serving` and
     `python -m paddle_tpu serve`.
+  * slo.py — per-model availability/latency objectives with fast/slow
+    window burn-rate evaluation, fed one outcome per request by the
+    batcher and scraped via `slo_burn_rate{model,window}` / `/healthz`.
 """
 
 from .engine import (ServingEngine, bucket_ladder, is_training_only_op,
                      training_only_op_types)
 from .batcher import DynamicBatcher
 from .harness import overload_report, run_load
+from .slo import SLO, SLOMonitor, monitor_for
 from ..errors import ServingOverloadError
 
 __all__ = ["ServingEngine", "DynamicBatcher", "ServingOverloadError",
            "bucket_ladder", "is_training_only_op", "training_only_op_types",
-           "overload_report", "run_load"]
+           "overload_report", "run_load", "SLO", "SLOMonitor",
+           "monitor_for"]

@@ -34,11 +34,14 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .. import telemetry
+from .. import tracing
 from ..errors import ServingOverloadError
+from . import slo as slo_mod
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "future", "submit_t", "deadline_t")
+    __slots__ = ("feed", "rows", "future", "submit_t", "deadline_t",
+                 "span")
 
     def __init__(self, feed, rows, deadline_t):
         self.feed = feed
@@ -46,6 +49,7 @@ class _Request:
         self.future: Future = Future()
         self.submit_t = time.monotonic()
         self.deadline_t = deadline_t
+        self.span = None
 
 
 class DynamicBatcher:
@@ -58,7 +62,8 @@ class DynamicBatcher:
     """
 
     def __init__(self, engine, max_batch: Optional[int] = None,
-                 max_delay_ms: float = 5.0, max_queue_depth: int = 64):
+                 max_delay_ms: float = 5.0, max_queue_depth: int = 64,
+                 slo: Optional["slo_mod.SLO"] = None):
         self.engine = engine
         self.max_batch = int(max_batch or engine.max_batch)
         if self.max_batch > engine.max_batch:
@@ -68,6 +73,10 @@ class DynamicBatcher:
         self.max_delay = float(max_delay_ms) / 1000.0
         self.max_queue_depth = int(max_queue_depth)
         self._label = getattr(engine, "_label", "p?")
+        # every request outcome (completed / shed / failed) feeds the
+        # model's burn-rate monitor; shared process-wide by model label
+        # so /healthz sees it too
+        self.slo_monitor = slo_mod.monitor_for(self._label, slo=slo)
         self._cond = threading.Condition()
         self._queue: "collections.deque[_Request]" = collections.deque()
         self._pending_rows = 0
@@ -119,6 +128,14 @@ class DynamicBatcher:
                     f">= max_queue_depth {self.max_queue_depth})",
                     reason="queue_full", queue_depth=len(self._queue))
             req = _Request(feed, rows, deadline_t)
+            if tracing.enabled():
+                req.span = tracing.start_span(
+                    "serving_request", parent=None,
+                    attrs={"program": self._label, "rows": rows})
+                # pin the span start to the submit timestamp so the
+                # queue child tiles the parent exactly
+                if req.span.sampled:
+                    req.span.start = req.submit_t
             self._queue.append(req)
             self._pending_rows += rows
             self._depth_gauge_locked()
@@ -145,6 +162,8 @@ class DynamicBatcher:
                     req = self._queue.popleft()
                     self._pending_rows -= req.rows
                     self._shed_locked("shutdown")
+                    if req.span is not None:
+                        req.span.end(outcome="shed", reason="shutdown")
                     req.future.set_exception(ServingOverloadError(
                         "serving batcher shut down", reason="shutdown",
                         queue_depth=len(self._queue)))
@@ -214,6 +233,8 @@ class DynamicBatcher:
                 # the client stopped waiting — don't spend device time
                 with self._cond:
                     self._shed_locked("deadline")
+                if req.span is not None:
+                    req.span.end(outcome="shed", reason="deadline")
                 req.future.set_exception(ServingOverloadError(
                     f"deadline expired after "
                     f"{(pop_t - req.submit_t) * 1e3:.1f}ms in queue",
@@ -225,10 +246,19 @@ class DynamicBatcher:
         feed = {name: np.concatenate(
                     [np.asarray(r.feed[name]) for r in live], axis=0)
                 for name in self.engine.feed_names}
+        # phase marks: run_batch fills (start, end) monotonic pairs for
+        # pad / bucket_select / compute so per-request child spans can be
+        # recorded retroactively without a second clock on the hot path
+        marks = ({} if any(r.span is not None and r.span.sampled
+                           for r in live) else None)
         try:
-            fetch = self.engine.run_batch(feed)
+            fetch = self.engine.run_batch(feed, _phase_marks=marks)
         except BaseException as e:  # scatter the failure, keep serving
             for req in live:
+                self.slo_monitor.record(ok=False)
+                if req.span is not None:
+                    req.span.end(outcome="error",
+                                 error=f"{type(e).__name__}: {e}")
                 if not req.future.cancelled():
                     req.future.set_exception(e)
             return
@@ -238,6 +268,12 @@ class DynamicBatcher:
             "per-request latency by phase (queue wait / device compute / "
             "total)", labels=("program", "phase"))
         off = 0
+        # every scatter child starts where the compute mark ended: the
+        # slice/convert/set_result stretch after compute is delivery
+        # latency from each request's point of view, even though the
+        # batch scatters results one request at a time
+        scatter_t = (marks or {}).get("compute",
+                                      (done_t, done_t))[1]
         for req in live:
             out = [f[off:off + req.rows] for f in fetch]
             off += req.rows
@@ -249,10 +285,51 @@ class DynamicBatcher:
                 done_t - pop_t)
             hist.labels(program=self._label, phase="total").observe(
                 done_t - req.submit_t)
+            self.slo_monitor.record(ok=True,
+                                    latency_s=done_t - req.submit_t)
+            if req.span is not None and req.span.sampled:
+                self._record_children(
+                    req, pop_t, done_t, marks or {}, close, scatter_t)
+
+    def _record_children(self, req: _Request, pop_t: float, done_t: float,
+                         marks: Dict, close: str,
+                         scatter_start: float) -> float:
+        """Record this request's queue/pad/bucket_select/compute/scatter
+        children and end the parent span. The children tile the parent
+        interval contiguously: queue ends at pop_t, pad absorbs the
+        coalesce+validate+pad stretch up to the marks' pad end,
+        bucket_select and compute come from the engine's marks, and
+        scatter runs from the batch's compute end to the moment this
+        request's result was delivered — so each request's child
+        durations sum to its parent's within measurement noise (scatter
+        children of co-batched requests overlap; they live in different
+        traces)."""
+        sp = req.span
+        tracing.record_span("queue", req.submit_t, pop_t, parent=sp,
+                            attrs={"close": close})
+        pad = marks.get("pad")
+        sel = marks.get("bucket_select")
+        comp = marks.get("compute")
+        pad_end = pad[1] if pad else pop_t
+        tracing.record_span("pad", pop_t, pad_end, parent=sp,
+                            attrs={"rows": req.rows})
+        if sel:
+            tracing.record_span("bucket_select", sel[0], sel[1],
+                                parent=sp,
+                                attrs={"bucket": marks.get("bucket")})
+        if comp:
+            tracing.record_span("compute", comp[0], comp[1], parent=sp,
+                                attrs={"bucket": marks.get("bucket")})
+        end_t = time.monotonic()
+        tracing.record_span("scatter", scatter_start, end_t, parent=sp)
+        sp.end(end=end_t, outcome="ok",
+               bucket=marks.get("bucket"))
+        return end_t
 
     # --- accounting ---------------------------------------------------------
     def _shed_locked(self, reason: str):
         self.shed += 1
+        self.slo_monitor.record(ok=False)
         telemetry.counter(
             "serving_shed_total",
             "requests rejected by overload control, by cause",
@@ -272,7 +349,7 @@ class DynamicBatcher:
 
     def stats(self) -> Dict[str, object]:
         with self._cond:
-            return {
+            out = {
                 "submitted": self.submitted,
                 "completed": self.completed,
                 "shed": self.shed,
@@ -281,3 +358,5 @@ class DynamicBatcher:
                 "goodput_fraction": (self.completed / self.submitted
                                      if self.submitted else 1.0),
             }
+        out["slo"] = self.slo_monitor.report()
+        return out

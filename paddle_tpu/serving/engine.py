@@ -301,13 +301,20 @@ class ServingEngine:
 
     # --- execution ----------------------------------------------------------
     def run_batch(self, feed: Dict[str, np.ndarray],
-                  valid_rows: Optional[int] = None) -> List[np.ndarray]:
+                  valid_rows: Optional[int] = None,
+                  _phase_marks: Optional[Dict] = None) -> List[np.ndarray]:
         """Execute one coalesced batch: pad to the smallest admissible
         bucket, run its AOT executable, slice the valid rows back out.
         The donated state round-trips: the returned new_state (same
-        buffers off-CPU) becomes the resident state for the next call."""
+        buffers off-CPU) becomes the resident state for the next call.
+
+        `_phase_marks`, when a dict, is filled with contiguous
+        (start, end) monotonic pairs for the pad / bucket_select /
+        compute phases (+ the chosen bucket) — the tracing hook the
+        batcher uses to record per-request child spans retroactively."""
         if self._closed:
             raise RuntimeError("ServingEngine is closed")
+        t_enter = time.monotonic() if _phase_marks is not None else 0.0
         arrays = {}
         n = None
         for name in self.feed_names:
@@ -342,9 +349,18 @@ class ServingEngine:
                 arrays = self._emb_cache.prepare_feed(arrays)
             padded = {name: _pad_rows(a, bucket)
                       for name, a in arrays.items()}
+            if _phase_marks is not None:
+                t_pad = time.monotonic()
+                _phase_marks["bucket"] = bucket
+                _phase_marks["pad"] = (t_enter, t_pad)
             ex = self._executable(bucket)
+            if _phase_marks is not None:
+                t_sel = time.monotonic()
+                _phase_marks["bucket_select"] = (t_pad, t_sel)
             fetch, _lens, new_state = ex(padded, self._state,
                                          np.uint32(0))
+            if _phase_marks is not None:
+                _phase_marks["compute"] = (t_sel, time.monotonic())
             self._state = new_state
         self.bucket_runs[bucket] = self.bucket_runs.get(bucket, 0) + 1
         telemetry.counter(

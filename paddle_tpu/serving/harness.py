@@ -16,12 +16,15 @@ from __future__ import annotations
 
 import threading
 import time
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from .. import telemetry
 from ..errors import ServingOverloadError
+
+_RESULT_TIMEOUT_S = 60.0
 
 
 def run_load(batcher, make_feed: Callable[[int, int], Dict],
@@ -37,6 +40,8 @@ def run_load(batcher, make_feed: Callable[[int, int], Dict],
     latencies_ms: List[float] = []
     ok = [0]
     shed = [0]
+    timeouts = [0]
+    errors = [0]
     lock = threading.Lock()
 
     def client(ci: int):
@@ -45,10 +50,23 @@ def run_load(batcher, make_feed: Callable[[int, int], Dict],
             t0 = time.monotonic()
             try:
                 fut = batcher.submit(feed, deadline_ms=deadline_ms)
-                fut.result(timeout=60.0)
+                fut.result(timeout=_RESULT_TIMEOUT_S)
             except ServingOverloadError:
                 with lock:
                     shed[0] += 1
+                continue
+            except _FutureTimeout:
+                # a stuck future must not kill the client thread: count
+                # the timeout outcome and keep issuing this client's
+                # remaining requests
+                with lock:
+                    timeouts[0] += 1
+                continue
+            except Exception:
+                # engine failure scattered onto the future — account it,
+                # keep the load going
+                with lock:
+                    errors[0] += 1
                 continue
             dt_ms = (time.monotonic() - t0) * 1e3
             with lock:
@@ -64,7 +82,7 @@ def run_load(batcher, make_feed: Callable[[int, int], Dict],
         t.join()
     wall_s = max(time.monotonic() - t0, 1e-9)
 
-    submitted = ok[0] + shed[0]
+    submitted = ok[0] + shed[0] + timeouts[0] + errors[0]
     bucket_hits = {
         str(b): engine.bucket_runs.get(b, 0) - runs_before.get(b, 0)
         for b in engine.buckets
@@ -79,6 +97,8 @@ def run_load(batcher, make_feed: Callable[[int, int], Dict],
         "qps": ok[0] / wall_s,
         "shed_fraction": shed[0] / submitted if submitted else 0.0,
         "goodput_fraction": ok[0] / submitted if submitted else 1.0,
+        "timeouts": timeouts[0],
+        "errors": errors[0],
         "bucket_hits": bucket_hits,
         "wall_s": wall_s,
     }
@@ -105,15 +125,31 @@ def overload_report(batcher, make_feed, clients: int = 4,
     (shed_fraction > 0 under real pressure) while accepted requests keep
     completing — goodput degrades gracefully instead of latency
     collapsing."""
+    mon = getattr(batcher, "slo_monitor", None)
     normal = run_load(batcher, make_feed, clients=clients,
                       requests_per_client=requests_per_client,
                       deadline_ms=deadline_ms, label="normal")
+    # evaluate burn before the overload phase starts so the "normal"
+    # rates reflect only normal-phase traffic inside the windows
+    slo_normal = mon.report() if mon is not None else None
     overload = run_load(batcher, make_feed, clients=2 * clients,
                         requests_per_client=requests_per_client,
                         deadline_ms=deadline_ms, label="overload")
+    slo_overload = mon.report() if mon is not None else None
+    slo = None
+    if mon is not None:
+        slo = {
+            "objective": mon.slo.to_dict(),
+            "normal": {w: slo_normal["windows"][w]["burn_rate"]
+                       for w in ("fast", "slow")},
+            "overload": {w: slo_overload["windows"][w]["burn_rate"]
+                         for w in ("fast", "slow")},
+            "windows": slo_overload["windows"],
+        }
     return {
         "normal": normal,
         "overload": overload,
         "engine": batcher.engine.stats(),
         "batcher": batcher.stats(),
+        "slo": slo,
     }

@@ -349,7 +349,15 @@ def histogram_quantile(name: str, q: float, **labels) -> Optional[float]:
             hi = edges[i]
             frac = min(max((rank - prev) / c, 0.0), 1.0)
             return lo + (hi - lo) * frac
-    return edges[-1]  # rank fell in the +Inf tail: clamp to the last edge
+    # rank fell in the +Inf tail: the true quantile is beyond the last
+    # finite edge, so the returned value is a floor, not an estimate.
+    # Signal that once per (name, scrape interval) via a counter so
+    # dashboards can annotate the clamped p99 instead of trusting it.
+    counter("telemetry_quantile_tail_clamped_total",
+            "histogram_quantile ranks that fell in the +Inf bucket and "
+            "were clamped to the last finite edge (the returned quantile "
+            "is a floor)", labels=("name",)).labels(name=name).inc()
+    return edges[-1]
 
 
 def _host_index() -> int:
@@ -663,3 +671,203 @@ def reset():
     _REG.clear()
     with _events_lock:
         _events.clear()
+
+
+# --- declared metric catalog -------------------------------------------------
+# The single source of truth for every metric family this codebase may
+# create: name -> (kind, label set, help). `tools/check_registry.py
+# check_metric_names` lints both directions against it — every
+# counter()/gauge()/histogram() call site in paddle_tpu/ must declare a
+# cataloged name with the cataloged label set, and every catalog entry
+# must have at least one emitter — so label-set drift between emitters
+# and readers (read_gauge/fleet.py/obs dashboards) is caught at lint
+# time, not on a dashboard. Entries marked dynamic=True are created with
+# a computed name (a loop or a program-attached mark) that the AST
+# scanner cannot see; the lint exempts them from the needs-an-emitter
+# direction but still checks any readers.
+
+def _m(kind, labels=(), help="", dynamic=False):
+    return {"kind": kind, "labels": tuple(labels), "help": help,
+            "dynamic": dynamic}
+
+
+METRIC_CATALOG = {
+    # executor
+    "executor_runs_total": _m("counter", ("program", "place", "mode"),
+                              "Executor.run calls"),
+    "executor_steps_total": _m("counter", ("program", "place"),
+                               "training/eval steps executed"),
+    "executor_run_seconds": _m("histogram", ("program", "mode"),
+                               "Executor.run wall seconds"),
+    "executor_last_step_seconds": _m("gauge", (),
+                                     "wall seconds of the latest step"),
+    "executor_compiles_total": _m("counter", ("program", "place"),
+                                  "block traces/compiles"),
+    "executor_compile_seconds_total": _m(
+        "counter", ("program", "place"),
+        "XLA compile wall seconds inside Executor.run"),
+    "executor_cache_hits_total": _m(
+        "counter", ("program", "place"),
+        "runs served by an already-traced signature"),
+    "executor_cache_misses_total": _m(
+        "counter", ("program", "place"), "signature-cache misses"),
+    "executor_window_fallback_total": _m(
+        "counter", ("program", "reason"),
+        "run_steps windows that fell back to per-step execution"),
+    "optimizer_steps_total": _m("counter", ("program",),
+                                "runs of optimizer-carrying programs"),
+    "optimizer_minimize_total": _m("counter", ("optimizer",),
+                                   "Optimizer.minimize calls"),
+    "optimizer_global_norm": _m(
+        "gauge", ("program",),
+        "pre-clip gradient global norm (telemetry side-fetch)",
+        dynamic=True),
+    "jax_backend_compiles_total": _m("counter", (),
+                                     "XLA backend compiles observed"),
+    "jax_backend_compile_seconds_total": _m(
+        "counter", (), "XLA backend compile wall seconds"),
+    "donation_fallback_total": _m("counter", ("program",),
+                                  "buffer-donation fallbacks"),
+    "oom_errors_total": _m("counter", ("program",),
+                           "device OOMs classified by the executor"),
+    "nonfinite_detections_total": _m(
+        "counter", ("program", "source"),
+        "non-finite values caught by checks/probes"),
+    "feed_conversion_seconds": _m("histogram", (),
+                                  "host feed conversion wall seconds"),
+    "feed_conversion_seconds_total": _m(
+        "counter", (), "cumulative host feed conversion seconds"),
+    # fusion / lowering / kernels
+    "fusion_fallback_total": _m("counter", ("program", "reason"),
+                                "fusion pattern bail-outs"),
+    "pallas_kernel_total": _m("counter", ("op",),
+                              "pallas kernel launches"),
+    "pallas_fallback_total": _m("counter", ("op", "reason"),
+                                "pallas kernels that fell back to XLA"),
+    "pallas_kernel_coverage": _m("gauge", (),
+                                 "fraction of eligible ops on pallas"),
+    "kernel_efficiency": _m("gauge", ("op", "shape"),
+                            "measured/roofline kernel efficiency"),
+    "device_op_seconds_total": _m("counter", ("op",),
+                                  "per-op device seconds (profiled)"),
+    # sparse / embedding
+    "sparse_apply_rows_total": _m("counter", ("op",),
+                                  "rows touched by sparse applies"),
+    "sparse_densify_fallback_total": _m(
+        "counter", ("op", "reason"), "sparse paths densified"),
+    "emb_cache_hits_total": _m("counter", ("table",),
+                               "embedding hot-row cache hits"),
+    "emb_cache_misses_total": _m("counter", ("table",),
+                                 "embedding hot-row cache misses"),
+    "emb_cache_hit_rate": _m("gauge", ("table",),
+                             "embedding cache rolling hit rate"),
+    "emb_cache_evictions_total": _m("counter", ("policy",),
+                                    "embedding cache evictions"),
+    "emb_cache_flush_bytes_total": _m(
+        "counter", (), "dirty embedding bytes flushed to host"),
+    "emb_cache_prefetch_total": _m("counter", (),
+                                   "embedding prefetch batches staged"),
+    "emb_cache_prefetch_overlap_fraction": _m(
+        "gauge", (), "prefetch time hidden under compute"),
+    # memory
+    "hbm_bytes_in_use": _m("gauge", ("device",),
+                           "live HBM bytes (tracker)"),
+    "hbm_peak_bytes": _m("gauge", ("device",), "peak HBM bytes"),
+    "hbm_limit_bytes": _m("gauge", ("device",), "HBM capacity"),
+    "hbm_class_bytes": _m("gauge", ("device", "kind"),
+                          "HBM bytes by allocation class"),
+    # input pipeline
+    "input_batches_total": _m("counter", (), "reader batches produced"),
+    "input_windows_total": _m("counter", (), "reader windows produced"),
+    "input_window_dropped_batches_total": _m(
+        "counter", (), "tail batches dropped at window close"),
+    "input_stall_seconds": _m("histogram", (),
+                              "executor wait on the input pipeline"),
+    # checkpoint io
+    "checkpoint_bytes": _m("gauge", ("op",),
+                           "payload bytes of the last save/load"),
+    "checkpoint_saves_total": _m("counter", (),
+                                 "checkpoints written by this process"),
+    "checkpoint_last_step": _m("gauge", (),
+                               "step of the newest checkpoint"),
+    "checkpoint_save_seconds": _m("histogram", (),
+                                  "wall seconds per checkpoint save",
+                                  dynamic=True),
+    "checkpoint_load_seconds": _m("histogram", (),
+                                  "wall seconds per checkpoint load",
+                                  dynamic=True),
+    # multihost / fleet
+    "multihost_initialize_total": _m("counter", (),
+                                     "distributed init calls"),
+    "multihost_processes": _m("gauge", (), "process count at init"),
+    "fleet_step_skew": _m("gauge", (), "max-min step skew across hosts"),
+    "fleet_straggler_host": _m("gauge", (),
+                               "host index of the slowest step"),
+    "goodput_fraction": _m("gauge", (), "goodput fraction of wall time"),
+    "goodput_seconds": _m("gauge", ("bucket",),
+                          "wall seconds by goodput bucket"),
+    "collective_time_seconds": _m("gauge", (),
+                                  "total collective device seconds"),
+    "collective_exposed_seconds": _m(
+        "gauge", (), "collective seconds not hidden by compute"),
+    # planner / parallel
+    "planner_fallback_total": _m("counter", ("program", "reason"),
+                                 "sharding planner bail-outs"),
+    "overlap_buckets_total": _m("counter", ("program",),
+                                "gradient overlap buckets built"),
+    "overlap_fallback_total": _m("counter", ("program", "reason"),
+                                 "overlap scheduling bail-outs"),
+    # grad audit
+    "grad_l2": _m("gauge", ("program", "param"), "per-param grad L2"),
+    "grad_abs_mean": _m("gauge", ("program", "param"),
+                        "per-param grad |mean|"),
+    "grad_audit_flags_total": _m("counter",
+                                 ("program", "param", "status"),
+                                 "grad audit anomaly flags"),
+    # profiler / roofline
+    "profiler_sessions_total": _m("counter", ("traced",),
+                                  "profiler sessions"),
+    "profiler_event_seconds": _m("histogram", ("event",),
+                                 "profiler event wall seconds"),
+    "mfu_nominal": _m("gauge", (), "MFU vs nominal peak", dynamic=True),
+    "mfu_vs_sustained": _m("gauge", (), "MFU vs sustained peak",
+                           dynamic=True),
+    "device_duty_cycle": _m("gauge", (), "device busy fraction",
+                            dynamic=True),
+    # inspector
+    "inspector_crash_reports_total": _m(
+        "counter", (), "crash reports written"),
+    # serving
+    "serving_request_seconds": _m("histogram", ("program", "phase"),
+                                  "per-request latency by phase"),
+    "serving_batches_total": _m("counter", ("program", "close"),
+                                "batches closed, by close cause"),
+    "serving_shed_total": _m("counter", ("program", "reason"),
+                             "requests shed by overload control"),
+    "serving_queue_depth": _m("gauge", ("program",),
+                              "requests waiting in the batcher"),
+    "serving_bucket_runs_total": _m("counter", ("program", "bucket"),
+                                    "batches executed per bucket"),
+    "serving_cache_hit_total": _m("counter", ("program", "bucket"),
+                                  "AOT executable cache hits"),
+    "serving_cache_miss_total": _m("counter", ("program", "bucket"),
+                                   "AOT executable cache misses"),
+    "serving_cache_evictions_total": _m(
+        "counter", ("program",), "bucket executables LRU-evicted"),
+    "serving_compile_seconds": _m("histogram", ("program", "bucket"),
+                                  "AOT lower+compile seconds"),
+    "serving_fallback_total": _m("counter", ("program", "reason"),
+                                 "requests on the non-AOT path"),
+    # observability plane (this PR)
+    "slo_burn_rate": _m("gauge", ("model", "window"),
+                        "error-budget burn rate by window"),
+    "telemetry_quantile_tail_clamped_total": _m(
+        "counter", ("name",),
+        "quantiles clamped to the last finite bucket edge"),
+    "trace_spans_total": _m("counter", ("name",),
+                            "finished (sampled) trace spans"),
+    "trace_spans_dropped_total": _m(
+        "counter", (), "spans evicted from the trace ring buffer"),
+    "obs_requests_total": _m("counter", ("endpoint",),
+                             "observability endpoint scrapes"),
+}

@@ -1,0 +1,127 @@
+"""bench_diff regression gate (ISSUE 16 satellite): direction
+classification, threshold behaviour, drift reporting, exit codes."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from tools import bench_diff  # noqa: E402
+
+
+def _payload(parsed):
+    return {"benchmark": "serving", "parsed": parsed}
+
+
+def test_direction_vocabulary():
+    assert bench_diff.direction("overload.p99_ms") == "lower"
+    assert bench_diff.direction("shed_fraction") == "lower"
+    assert bench_diff.direction("compile_seconds") == "lower"
+    assert bench_diff.direction("value") == "higher"
+    assert bench_diff.direction("normal.qps") == "higher"
+    assert bench_diff.direction("mfu_nominal") == "higher"
+    assert bench_diff.direction("bucket_hits.b4") == "higher"
+    # lower-better wins when both match (timeout_hits would be absurd,
+    # but the order must be deterministic)
+    assert bench_diff.direction("timeout_hit") == "lower"
+    assert bench_diff.direction("rows") is None
+
+
+def test_regression_and_improvement_classification():
+    old = _payload({"value": 100.0, "p99_ms": 10.0,
+                    "normal": {"qps": 50.0}})
+    new = _payload({"value": 80.0,       # throughput down 20%: regress
+                    "p99_ms": 8.0,       # latency down 20%: improve
+                    "normal": {"qps": 51.0}})  # +2%: under threshold
+    reg, imp, drift = bench_diff.diff(old, new, threshold=0.05)
+    assert [e["key"] for e in reg] == ["value"]
+    assert reg[0]["change"] == pytest.approx(-0.2)
+    assert [e["key"] for e in imp] == ["p99_ms"]
+    assert imp[0]["change"] == pytest.approx(0.2)
+    assert drift == []
+
+
+def test_lower_better_regression_direction():
+    old = _payload({"p99_ms": 10.0})
+    new = _payload({"p99_ms": 15.0})
+    reg, imp, _ = bench_diff.diff(old, new)
+    assert [e["key"] for e in reg] == ["p99_ms"]
+    assert reg[0]["change"] == pytest.approx(-0.5)
+    assert imp == []
+
+
+def test_threshold_gates_regressions():
+    old = _payload({"value": 100.0})
+    new = _payload({"value": 92.0})
+    reg, _, _ = bench_diff.diff(old, new, threshold=0.05)
+    assert len(reg) == 1
+    reg, _, _ = bench_diff.diff(old, new, threshold=0.10)
+    assert reg == []
+
+
+def test_one_sided_keys_are_drift_not_failures():
+    old = _payload({"value": 100.0, "old_only_ms": 5.0})
+    new = _payload({"value": 100.0, "new_only_qps": 7.0})
+    reg, imp, drift = bench_diff.diff(old, new)
+    assert reg == [] and imp == []
+    assert drift == ["new_only_qps", "old_only_ms"]
+
+
+def test_uncompared_and_bool_keys_ignored():
+    old = _payload({"rows": 100.0, "ok": True})
+    new = _payload({"rows": 1.0, "ok": False})
+    reg, imp, drift = bench_diff.diff(old, new)
+    assert reg == [] and imp == []
+
+
+def _write(tmp_path, name, parsed):
+    p = tmp_path / name
+    p.write_text(json.dumps(_payload(parsed)))
+    return str(p)
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    clean_old = _write(tmp_path, "a.json", {"value": 100.0})
+    clean_new = _write(tmp_path, "b.json", {"value": 101.0})
+    assert bench_diff.main([clean_old, clean_new]) == 0
+    assert "bench diff ok" in capsys.readouterr().out
+
+    bad_new = _write(tmp_path, "c.json", {"value": 50.0})
+    assert bench_diff.main([clean_old, bad_new]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION value" in out
+    # the same delta passes with a huge threshold
+    assert bench_diff.main(
+        [clean_old, bad_new, "--threshold", "0.9"]) == 0
+    capsys.readouterr()
+
+    missing = str(tmp_path / "nope.json")
+    assert bench_diff.main([clean_old, missing]) == 2
+
+    garbage = tmp_path / "junk.json"
+    garbage.write_text("{not json")
+    assert bench_diff.main([clean_old, str(garbage)]) == 2
+
+
+def test_main_json_output(tmp_path, capsys):
+    old = _write(tmp_path, "a.json", {"p99_ms": 10.0, "extra": 1.0})
+    new = _write(tmp_path, "b.json", {"p99_ms": 20.0})
+    rc = bench_diff.main([old, new, "--json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["regressions"][0]["key"] == "p99_ms"
+    assert doc["drift"] == ["extra"]
+
+
+def test_cli_subprocess(tmp_path):
+    old = _write(tmp_path, "a.json", {"value": 100.0})
+    new = _write(tmp_path, "b.json", {"value": 100.0})
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_diff.py", old, new],
+        cwd=str(Path(__file__).resolve().parent.parent),
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert "bench diff ok" in proc.stdout

@@ -1,0 +1,462 @@
+"""Live observability plane (ISSUE 16): causal span tracing, the
+scrapeable HTTP endpoint, and SLO burn-rate monitoring.
+
+The acceptance properties pinned here: a serving request traced through
+submit -> coalesce -> engine yields a span tree whose
+queue+pad+compute+scatter children tile the parent (sum within 10%),
+exportable as valid chrome-trace JSON; /metrics, /healthz and /spans
+answer over real HTTP (http.client against the in-process server) while
+a workload runs; /healthz flips to 503 when steps stall and when a crash
+event lands; and the SLO monitor's fast/slow windows burn past 1.0
+exactly when the error budget is being overspent.
+"""
+
+import http.client
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu import obs_server, telemetry, tracing
+from paddle_tpu.serving import DynamicBatcher, ServingEngine
+from paddle_tpu.serving import slo as slo_mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs_state():
+    telemetry.reset()
+    tracing.reset()
+    slo_mod.reset()
+    yield
+    obs_server.stop()
+    telemetry.reset()
+    tracing.reset()
+    slo_mod.reset()
+
+
+def _get(port, route):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", route)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _get_json(port, route):
+    status, body = _get(port, route)
+    return status, json.loads(body)
+
+
+def _build_fc_engine(scope, max_batch=8):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        logits = fluid.layers.fc(input=h, size=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with executor_mod.scope_guard(scope):
+        exe.run(startup)
+    return ServingEngine(main, feed_names=["x"],
+                         fetch_names=[logits.name], scope=scope,
+                         max_batch=max_batch)
+
+
+# --- tracing core ------------------------------------------------------------
+
+def test_span_context_nesting_and_parent_links():
+    tracing.enable()
+    with tracing.span("outer", program="p0") as outer:
+        with tracing.span("inner") as inner:
+            assert tracing.current_span() is inner
+        assert tracing.current_span() is outer
+    spans = {s["name"]: s for s in tracing.recent_spans()}
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["inner"]["trace_id"] == spans["outer"]["trace_id"]
+    assert spans["outer"]["parent_id"] is None
+    assert spans["outer"]["attrs"]["program"] == "p0"
+    assert spans["outer"]["end"] >= spans["inner"]["end"]
+
+
+def test_tracing_disabled_is_noop():
+    assert not tracing.enabled()
+    with tracing.span("nope") as sp:
+        sp.set_attr("k", "v").add_event("e")
+    assert tracing.recent_spans() == []
+    assert tracing.start_span("also_nope").sampled is False
+
+
+def test_record_span_retroactive_and_tree():
+    tracing.enable()
+    t0 = time.monotonic()
+    root = tracing.record_span("step", t0, t0 + 0.5,
+                               attrs={"program": "p0"})
+    tracing.record_span("compile", t0, t0 + 0.3, parent=root)
+    roots = tracing.trace_tree(root.trace_id)
+    assert len(roots) == 1
+    assert roots[0]["name"] == "step"
+    kids = roots[0]["children"]
+    assert [k["name"] for k in kids] == ["compile"]
+    assert abs(roots[0]["dur_s"] - 0.5) < 1e-9
+    assert abs(kids[0]["dur_s"] - 0.3) < 1e-9
+
+
+def test_head_sampling_is_deterministic_and_whole_trace():
+    tracing.enable(sample=0.25)
+    kept = 0
+    for _ in range(16):
+        root = tracing.start_span("req")
+        child = tracing.start_span("phase", parent=root)
+        child.end()
+        root.end()
+        kept += root.sampled
+        # the keep/drop decision is inherited: never a partial tree
+        assert child.sampled == root.sampled
+    assert kept == 4
+    assert len(tracing.recent_spans(name="req")) == 4
+
+
+def test_ring_buffer_bounded_with_drop_counter():
+    tracing.enable(capacity=10)
+    t0 = time.monotonic()
+    for i in range(25):
+        tracing.record_span(f"s{i}", t0, t0 + 0.001)
+    spans = tracing.recent_spans()
+    assert len(spans) == 10
+    assert spans[-1]["name"] == "s24"   # newest survives
+    dropped = telemetry.read_series("trace_spans_dropped_total")
+    assert sum(dropped.values()) == 15
+
+
+def test_jsonl_export(tmp_path):
+    tracing.enable()
+    t0 = time.monotonic()
+    tracing.record_span("a", t0, t0 + 0.1)
+    tracing.record_span("b", t0, t0 + 0.2)
+    path = tmp_path / "spans.jsonl"
+    assert tracing.export_jsonl(str(path)) == 2
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [l["name"] for l in lines] == ["a", "b"]
+
+
+def test_env_enable_sampling(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACE", "0.5")
+    tracing.maybe_enable_from_env()
+    assert tracing.enabled()
+    tracing.reset()
+    monkeypatch.setenv("PADDLE_TPU_TRACE", "0")
+    tracing.maybe_enable_from_env()
+    assert not tracing.enabled()
+
+
+# --- serving request span tree (acceptance) ----------------------------------
+
+def test_serving_span_tree_children_sum_to_parent(tmp_path):
+    """A traced request's queue+pad+compute+scatter children must account
+    for the parent within 10%, and the ring must export as loadable
+    chrome-trace JSON (acceptance criterion)."""
+    scope = executor_mod.Scope()
+    eng = _build_fc_engine(scope)
+    rng = np.random.RandomState(0)
+    # warm every bucket the test could hit OUTSIDE tracing, so compile
+    # time doesn't dominate bucket_select
+    for n in (1, 2, 4, 8):
+        eng.run_batch({"x": rng.randn(n, 16).astype(np.float32)})
+    tracing.enable()
+    with DynamicBatcher(eng, max_delay_ms=2.0) as batcher:
+        futs = [batcher.submit(
+                    {"x": rng.randn(2, 16).astype(np.float32)})
+                for _ in range(4)]
+        for f in futs:
+            f.result(timeout=30.0)
+    roots = tracing.recent_spans(name="serving_request")
+    assert len(roots) == 4
+    for root in roots:
+        assert root["attrs"]["outcome"] == "ok"
+        tree = tracing.trace_tree(root["trace_id"])
+        assert len(tree) == 1
+        kids = tree[0]["children"]
+        names = [k["name"] for k in kids]
+        for want in ("queue", "pad", "bucket_select", "compute",
+                     "scatter"):
+            assert want in names, f"missing child {want} in {names}"
+        parent_dur = tree[0]["dur_s"]
+        core = sum(k["dur_s"] for k in kids
+                   if k["name"] in ("queue", "pad", "compute",
+                                    "scatter"))
+        every = sum(k["dur_s"] for k in kids)
+        assert parent_dur > 0
+        # all children tile the parent; the named four are within 10%
+        assert abs(every - parent_dur) <= 0.10 * parent_dur + 1e-4
+        assert core >= 0.90 * parent_dur - 1e-4
+        assert core <= parent_dur + 1e-4
+
+    out = tmp_path / "trace.json"
+    n_events = tracing.export_chrome_trace(str(out))
+    doc = json.loads(out.read_text())
+    assert isinstance(doc["traceEvents"], list)
+    assert n_events == len(doc["traceEvents"])
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == len(tracing.recent_spans())
+    for e in xs:
+        assert e["dur"] >= 0 and "name" in e and "ts" in e
+
+
+def test_serving_shed_requests_end_spans():
+    """Queue-full rejections happen before a span exists; deadline sheds
+    end the request span with outcome=shed."""
+    scope = executor_mod.Scope()
+    eng = _build_fc_engine(scope)
+    rng = np.random.RandomState(1)
+    eng.run_batch({"x": rng.randn(4, 16).astype(np.float32)})
+    tracing.enable()
+    batcher = DynamicBatcher(eng, max_delay_ms=1.0)  # never started
+    fut = batcher.submit({"x": rng.randn(2, 16).astype(np.float32)},
+                         deadline_ms=0.0)
+    time.sleep(0.01)
+    batcher.start()
+    with pytest.raises(Exception):
+        fut.result(timeout=30.0)
+    batcher.stop()
+    shed = [s for s in tracing.recent_spans(name="serving_request")
+            if s["attrs"].get("outcome") == "shed"]
+    assert len(shed) == 1
+    assert shed[0]["attrs"]["reason"] == "deadline"
+
+
+# --- training step spans -----------------------------------------------------
+
+def test_executor_step_spans():
+    tracing.enable()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, startup_program=startup)
+    scope = executor_mod.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(4, 4).astype(np.float32),
+            "y": rng.randn(4, 1).astype(np.float32)}
+    with executor_mod.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+    steps = tracing.recent_spans(name="step")
+    assert len(steps) >= 3
+    assert all(s["attrs"]["program"] for s in steps)
+    # the first (compiling) step carries a compile child
+    compiles = tracing.recent_spans(name="compile")
+    assert compiles, "no compile child recorded for the cold step"
+    step_ids = {s["span_id"] for s in steps}
+    assert all(c["parent_id"] in step_ids for c in compiles)
+    for c in compiles:
+        parent = next(s for s in steps
+                      if s["span_id"] == c["parent_id"])
+        assert c["dur_s"] <= parent["dur_s"] + 1e-9
+
+
+def test_checkpoint_spans(tmp_path):
+    tracing.enable()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=2)
+    scope = executor_mod.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with executor_mod.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_params(exe, str(tmp_path), main)
+        fluid.io.load_params(exe, str(tmp_path), main)
+    assert len(tracing.recent_spans(name="checkpoint_save")) == 1
+    assert len(tracing.recent_spans(name="checkpoint_load")) == 1
+    save = tracing.recent_spans(name="checkpoint_save")[0]
+    assert save["attrs"]["bytes"] > 0
+
+
+# --- SLO burn rate -----------------------------------------------------------
+
+def test_slo_burn_rate_windows():
+    clock = [1000.0]
+    mon = slo_mod.SLOMonitor(
+        slo_mod.SLO("m0", availability=0.999),
+        clock=lambda: clock[0])
+    for _ in range(995):
+        mon.record(ok=True)
+    assert mon.burn_rate(slo_mod.FAST_WINDOW_S) == 0.0
+    for _ in range(5):
+        mon.record(ok=False)
+    # 5/1000 bad against a 0.001 budget: burning 5x
+    rep = mon.report()
+    assert rep["windows"]["fast"]["burn_rate"] == pytest.approx(5.0)
+    assert rep["windows"]["slow"]["burn_rate"] == pytest.approx(5.0)
+    assert telemetry.read_gauge("slo_burn_rate", model="m0",
+                                window="fast") == pytest.approx(5.0)
+    # fast window forgets the incident, slow window still remembers
+    clock[0] += slo_mod.FAST_WINDOW_S + 1
+    rep = mon.report()
+    assert rep["windows"]["fast"]["burn_rate"] == 0.0
+    assert rep["windows"]["slow"]["burn_rate"] == pytest.approx(5.0)
+    # and the slow window ages out too
+    clock[0] += slo_mod.SLOW_WINDOW_S
+    rep = mon.report()
+    assert rep["windows"]["slow"]["burn_rate"] == 0.0
+    assert rep["windows"]["slow"]["total"] == 0
+
+
+def test_slo_latency_objective_counts_slow_success_as_bad():
+    mon = slo_mod.SLOMonitor(
+        slo_mod.SLO("m1", availability=0.9, latency_ms=50.0))
+    mon.record(ok=True, latency_s=0.01)
+    mon.record(ok=True, latency_s=0.2)   # completed but too slow
+    rep = mon.report()
+    assert rep["windows"]["fast"]["bad"] == 1
+    assert rep["windows"]["fast"]["burn_rate"] == pytest.approx(5.0)
+
+
+def test_slo_registry_shared_per_model():
+    a = slo_mod.monitor_for("modelA")
+    assert slo_mod.monitor_for("modelA") is a
+    a.record(ok=False)
+    reports = slo_mod.all_reports()
+    assert "modelA" in reports
+    assert reports["modelA"]["windows"]["fast"]["bad"] == 1
+
+
+def test_batcher_stats_carry_slo():
+    scope = executor_mod.Scope()
+    eng = _build_fc_engine(scope)
+    rng = np.random.RandomState(2)
+    with DynamicBatcher(eng, max_delay_ms=2.0) as batcher:
+        fut = batcher.submit(
+            {"x": rng.randn(2, 16).astype(np.float32)})
+        fut.result(timeout=30.0)
+        stats = batcher.stats()
+    assert stats["slo"]["windows"]["fast"]["total"] == 1
+    assert stats["slo"]["windows"]["fast"]["burn_rate"] == 0.0
+    assert stats["slo"]["objective"]["availability"] == 0.999
+
+
+# --- HTTP endpoints ----------------------------------------------------------
+
+def test_obs_endpoints_serve_live_data():
+    srv = obs_server.start(port=0)
+    assert srv.port
+    tracing.enable()
+    telemetry.counter("input_batches_total",
+                      "reader batches produced").inc(3)
+    t0 = time.monotonic()
+    tracing.record_span("step", t0, t0 + 0.01,
+                        attrs={"program": "p0"})
+
+    status, body = _get(srv.port, "/metrics")
+    assert status == 200
+    text = body.decode()
+    assert "# TYPE input_batches_total counter" in text
+    assert "input_batches_total 3" in text
+    assert "obs_requests_total" in text   # the scrape counts itself
+
+    status, spans = _get_json(srv.port, "/spans?n=5")
+    assert status == 200
+    assert spans["enabled"] is True
+    assert [s["name"] for s in spans["spans"]] == ["step"]
+
+    status, report = _get_json(srv.port, "/report")
+    assert status == 200
+    assert report["spans_buffered"] == 1
+    assert report["metrics_families"] >= 1
+
+    status, index = _get_json(srv.port, "/")
+    assert status == 200
+    assert "/metrics" in index["endpoints"]
+
+    status, _err = _get_json(srv.port, "/nope")
+    assert status == 404
+
+
+def test_healthz_verdicts_and_stall_flip():
+    srv = obs_server.start(port=0)
+    # never stepped: healthy (a pure serving process is not stalled)
+    status, rep = _get_json(srv.port, "/healthz")
+    assert status == 200
+    assert rep["checks"]["step"]["ran"] is False
+
+    telemetry.log_event("run", program="p0", seconds=0.01)
+    telemetry.gauge(
+        "executor_last_step_seconds",
+        "wall seconds of the most recent executor step").set(0.01)
+    status, rep = _get_json(srv.port, "/healthz?max_age=60")
+    assert status == 200 and rep["status"] == "ok"
+    assert rep["checks"]["step"]["stalled"] is False
+
+    # steps stall: the same scrape with a tight staleness threshold
+    # flips to 503 (acceptance criterion)
+    time.sleep(0.05)
+    status, rep = _get_json(srv.port, "/healthz?max_age=0.01")
+    assert status == 503
+    assert rep["status"] == "unhealthy"
+    assert rep["checks"]["step"]["stalled"] is True
+
+
+def test_healthz_crash_and_slo_degraded():
+    srv = obs_server.start(port=0)
+    # SLO burning fast -> degraded but still 200 (alert, not dead)
+    mon = slo_mod.monitor_for("m9")
+    for _ in range(10):
+        mon.record(ok=False)
+    status, rep = _get_json(srv.port, "/healthz")
+    assert status == 200
+    assert rep["status"] == "degraded"
+    assert rep["checks"]["slo"]["burn_rates"]["m9"]["fast"] > 1.0
+
+    # a crash event is a hard unhealthy
+    telemetry.log_event("crash", error="RuntimeError: boom",
+                        program="p0")
+    status, rep = _get_json(srv.port, "/healthz")
+    assert status == 503
+    assert rep["checks"]["last_error"]["error"] \
+        == "RuntimeError: boom"
+
+
+def test_crash_hook_logs_event():
+    """inspector.notify_crash feeds the event /healthz reads."""
+    from paddle_tpu import inspector
+    main = fluid.Program()
+    inspector.notify_crash(None, main, RuntimeError("kaput"))
+    evs = telemetry.recent_events(kind="crash")
+    assert len(evs) == 1
+    assert "kaput" in evs[0]["error"]
+
+
+def test_obs_cli_subcommand(tmp_path, capsys):
+    """`python -m paddle_tpu obs` end-to-end in-process: server up,
+    traced smoke steps, self-scrape over HTTP, chrome-trace export."""
+    from paddle_tpu import cli
+    out = tmp_path / "trace.json"
+    rc = cli.main(["obs", "--steps", "2", "--batch", "4",
+                   "--export-trace", str(out)])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(line)
+    assert summary["metrics"]["status"] == 200
+    assert summary["metrics"]["bytes"] > 0
+    assert summary["healthz"]["checks"]["step"]["ran"] is True
+    assert summary["spans"]["buffered"] > 0
+    doc = json.loads(out.read_text())
+    assert any(e.get("name") == "step" for e in doc["traceEvents"])
+
+
+def test_env_port_autostart(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_OBS_PORT", "0")
+    srv = obs_server.maybe_start_from_env()
+    assert srv is not None and srv.port
+    status, _ = _get(srv.port, "/metrics")
+    assert status == 200

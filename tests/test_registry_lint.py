@@ -303,3 +303,78 @@ def test_cli_catches_typo():
     finally:
         layout.AGNOSTIC_OPS.discard("definitely_not_an_op")
     assert ("layout.AGNOSTIC_OPS", "definitely_not_an_op") in problems
+
+
+def test_metric_names_consistent():
+    """ISSUE 16 satellite: every telemetry family created anywhere in
+    paddle_tpu/ must match telemetry.METRIC_CATALOG in name, kind, and
+    label set — and every cataloged non-dynamic entry must still have an
+    emitter. Either direction drifting means a dashboard/reader silently
+    gets None."""
+    problems = _load_checker().check_metric_names()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_metric_lint_catches_uncataloged_emitter(monkeypatch):
+    """Sanity (and proof the AST scan is non-vacuous): dropping a real
+    emitter's catalog entry trips the unknown-metric direction at its
+    actual call site."""
+    from paddle_tpu import telemetry
+
+    checker = _load_checker()
+    monkeypatch.delitem(telemetry.METRIC_CATALOG, "serving_shed_total")
+    problems = checker.check_metric_names()
+    assert any("serving_shed_total" in m and "not in" in m
+               for _, m in problems), problems
+    assert any(w.startswith("paddle_tpu") for w, m in problems
+               if "serving_shed_total" in m)
+
+
+def test_metric_lint_catches_kind_and_label_drift(monkeypatch):
+    from paddle_tpu import telemetry
+
+    checker = _load_checker()
+    orig = telemetry.METRIC_CATALOG["serving_shed_total"]
+    monkeypatch.setitem(
+        telemetry.METRIC_CATALOG, "serving_shed_total",
+        dict(orig, kind="gauge"))
+    problems = checker.check_metric_names()
+    assert any("created as counter" in m and "cataloged as gauge" in m
+               for _, m in problems), problems
+
+    monkeypatch.setitem(
+        telemetry.METRIC_CATALOG, "serving_shed_total",
+        dict(orig, labels=("program", "reason", "phantom")))
+    problems = checker.check_metric_names()
+    assert any("serving_shed_total" in m and "label-set drift" in m
+               for _, m in problems), problems
+
+
+def test_metric_lint_catches_dead_catalog_entry(monkeypatch):
+    from paddle_tpu import telemetry
+
+    checker = _load_checker()
+    monkeypatch.setitem(
+        telemetry.METRIC_CATALOG, "phantom_metric_total",
+        {"kind": "counter", "labels": (), "help": "", "dynamic": False})
+    problems = checker.check_metric_names()
+    assert any("phantom_metric_total" in m and "no counter" in m
+               for _, m in problems), problems
+
+
+def test_metric_lint_catches_reader_label_drift(monkeypatch):
+    """A reader passing a label set the emitter doesn't write is the
+    silent-None bug: read_gauge call sites must match the catalog."""
+    from paddle_tpu import telemetry
+
+    checker = _load_checker()
+    orig = telemetry.METRIC_CATALOG["executor_last_step_seconds"]
+    monkeypatch.setitem(
+        telemetry.METRIC_CATALOG, "executor_last_step_seconds",
+        dict(orig, labels=("phantom",)))
+    problems = checker.check_metric_names()
+    assert any("read" in m and "None" in m
+               and "executor_last_step_seconds" in m
+               for _, m in problems) or \
+        any("executor_last_step_seconds" in m and "drift" in m
+            for _, m in problems), problems

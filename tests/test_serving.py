@@ -22,7 +22,8 @@ from paddle_tpu import executor as executor_mod
 from paddle_tpu import telemetry
 from paddle_tpu.errors import ServingOverloadError
 from paddle_tpu.serving import (DynamicBatcher, ServingEngine, bucket_ladder,
-                                run_load)
+                                overload_report, run_load)
+from paddle_tpu.serving import slo as slo_mod
 
 
 def _build_fc(scope, train_steps=0, in_dim=16, classes=4):
@@ -457,3 +458,57 @@ def test_bench_serving_mode_json_line():
         assert key in line, (key, line)
     assert line["densify_fallbacks"] == 0
     assert 0.0 < line["p50_ms"] <= line["p99_ms"]
+
+def test_overload_report_slo_and_latency_bound():
+    """Overload acceptance (ISSUE 16): injected overload drives the SLO
+    fast-window burn above 1.0 while the normal phase stays below, and
+    the accepted-request p99 under overload stays within a bound of the
+    normal phase (shedding absorbs the excess, latency doesn't
+    collapse). `overload_report` must carry the `slo` sub-dict with both
+    windows."""
+    slo_mod.reset()   # monitors are process-wide keyed by program label
+    scope = executor_mod.Scope()
+    main, logits = _build_fc(scope, train_steps=2)
+    eng = ServingEngine(main, feed_names=["x"], fetch_names=[logits],
+                        scope=scope, buckets=[4])
+    rng = np.random.RandomState(8)
+    eng.run_batch(_feed(rng, 4))                # warm the only bucket
+
+    # ~15ms per 2-request batch + a 4-deep queue: a normal-phase client
+    # (4 clients, one in-flight request each) can see at most 3 queued
+    # strangers, so normal NEVER sheds; an overload client (8 total) can
+    # see up to 7, so overload must — the shed signal separates the
+    # phases deterministically
+    real_run_batch = eng.run_batch
+
+    def slow_run_batch(feed, **kw):
+        time.sleep(0.015)
+        return real_run_batch(feed, **kw)
+
+    eng.run_batch = slow_run_batch
+    b = DynamicBatcher(eng, max_delay_ms=30.0, max_queue_depth=4)
+    b.start()
+    try:
+        report = overload_report(
+            b, lambda ci, ri: _feed(np.random.RandomState(ci * 97 + ri), 2),
+            clients=4, requests_per_client=6)
+    finally:
+        b.stop()
+        eng.run_batch = real_run_batch
+        eng.close()
+
+    normal, over = report["normal"], report["overload"]
+    assert over["shed_fraction"] > 0.0
+    assert normal["p99_ms"] is not None and over["p99_ms"] is not None
+    # accepted-latency bound: overload p99 may grow (deeper queue) but
+    # must stay within a small multiple of normal — not collapse
+    assert over["p99_ms"] <= 6.0 * normal["p99_ms"] + 150.0
+
+    slo = report["slo"]
+    assert slo is not None
+    assert set(slo["windows"]) == {"fast", "slow"}
+    assert slo["objective"]["availability"] == pytest.approx(0.999)
+    # queue_full sheds overspend the 0.1% error budget immediately
+    assert slo["overload"]["fast"] > 1.0
+    assert slo["normal"]["fast"] <= 1.0
+    assert report["batcher"]["slo"]["windows"]["fast"]["bad"] > 0

@@ -94,6 +94,28 @@ class TestMetricPrimitives:
         assert telemetry.snapshot()["counters"]["t_race"][""] == 8000.0
 
 
+def test_histogram_quantile_tail_clamp_is_counted():
+    """When the requested rank falls in the +Inf bucket the returned
+    value is the last finite edge — a floor, not an estimate. That clamp
+    must be observable: telemetry_quantile_tail_clamped_total{name}
+    increments exactly when it happens (ISSUE 16 satellite)."""
+    h = telemetry.histogram("t_clamp", buckets=(0.1, 1.0), labels=("k",))
+    h.labels(k="a").observe(0.05)
+    h.labels(k="a").observe(50.0)      # +Inf tail
+    # p25 resolves inside a finite bucket: no clamp counted
+    assert telemetry.histogram_quantile("t_clamp", 0.25, k="a") \
+        == pytest.approx(0.05, abs=0.05)
+    assert telemetry.read_series(
+        "telemetry_quantile_tail_clamped_total") == {}
+    # p99's rank lands in the overflow: clamped to the last edge + count
+    assert telemetry.histogram_quantile("t_clamp", 0.99, k="a") == 1.0
+    clamped = telemetry.read_series("telemetry_quantile_tail_clamped_total")
+    assert clamped == {"name=t_clamp": 1.0}
+    telemetry.histogram_quantile("t_clamp", 0.99, k="a")
+    clamped = telemetry.read_series("telemetry_quantile_tail_clamped_total")
+    assert clamped == {"name=t_clamp": 2.0}
+
+
 # --- executor run tracing (ISSUE acceptance criteria) ------------------------
 
 def _build_train_program():

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Regression gate between two BENCH_*.json files (ISSUE 16 satellite).
+
+The BENCH_rNN campaign tracks one headline metric per round plus a
+`parsed` payload of secondary numbers (p50/p99 latency, MFU, goodput,
+shed fraction, bucket hits...). Nothing gated those numbers: a round
+could regress images/sec or p99 and the only trace would be a human
+eyeballing two JSON files. This tool is the gate:
+
+    python tools/bench_diff.py BENCH_r05.json BENCH_r06.json
+    python tools/bench_diff.py old.json new.json --threshold 0.10
+    python tools/bench_diff.py old.json new.json --json
+
+It walks both `parsed` dicts (recursing into sub-dicts like
+`overload`/`normal` phases), classifies each shared numeric key by
+direction — higher-better (value, qps, *fraction that measures goodput,
+MFU, hit counts) vs lower-better (latencies, shed/miss/eviction rates,
+seconds) — and flags any metric whose relative change exceeds the
+threshold in the losing direction. Exit status: 0 clean, 1 regressions
+found, 2 usage/parse errors. Keys present in only one file are reported
+as informational drift, not failures (benchmarks grow fields).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# direction vocabulary: a key matches the first rule whose substring it
+# contains (checked in order) — explicit names first, suffix families
+# after. "bucket_hits" style count dicts are compared per-key as
+# higher-better (a bucket losing all its traffic is a distribution
+# shift worth seeing).
+LOWER_BETTER_MARKERS = (
+    "p50_ms", "p99_ms", "latency", "_seconds", "seconds_", "wall_s",
+    "shed_fraction", "miss", "eviction", "stall", "skew", "dropped",
+    "timeout", "error", "exposed",
+)
+HIGHER_BETTER_MARKERS = (
+    "value", "qps", "images_per_sec", "mfu", "tflops", "goodput",
+    "hit", "coverage", "duty_cycle", "busbw", "overlap", "vs_baseline",
+)
+
+
+def direction(key: str) -> Optional[str]:
+    """'higher' | 'lower' | None (uncompared) for one metric key."""
+    k = key.lower()
+    for marker in LOWER_BETTER_MARKERS:
+        if marker in k:
+            return "lower"
+    for marker in HIGHER_BETTER_MARKERS:
+        if marker in k:
+            return "higher"
+    return None
+
+
+def _flatten(d: Dict, prefix: str = "") -> Dict[str, float]:
+    """parsed dict -> {dotted.key: float} over numeric leaves."""
+    out: Dict[str, float] = {}
+    for k, v in d.items():
+        path = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, path))
+        elif isinstance(v, bool):
+            continue
+        elif isinstance(v, (int, float)) and v is not None:
+            out[path] = float(v)
+    return out
+
+
+def diff(old: Dict, new: Dict, threshold: float = 0.05) \
+        -> Tuple[List[Dict], List[Dict], List[str]]:
+    """-> (regressions, improvements, drift). Each entry: {key, old,
+    new, change} with change as signed relative delta in the metric's
+    natural direction (positive = better)."""
+    old_flat = _flatten(old.get("parsed") or {})
+    new_flat = _flatten(new.get("parsed") or {})
+    regressions, improvements = [], []
+    drift = sorted(set(old_flat) ^ set(new_flat))
+    for key in sorted(set(old_flat) & set(new_flat)):
+        sense = direction(key)
+        if sense is None:
+            continue
+        a, b = old_flat[key], new_flat[key]
+        if a == b:
+            continue
+        base = max(abs(a), 1e-12)
+        rel = (b - a) / base
+        gain = rel if sense == "higher" else -rel
+        entry = {"key": key, "old": a, "new": b,
+                 "direction": sense, "change": gain}
+        if gain < -threshold:
+            regressions.append(entry)
+        elif gain > threshold:
+            improvements.append(entry)
+    regressions.sort(key=lambda e: e["change"])
+    improvements.sort(key=lambda e: -e["change"])
+    return regressions, improvements, drift
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare two BENCH_*.json files; nonzero exit on "
+                    "regression beyond --threshold")
+    ap.add_argument("old", help="baseline BENCH json")
+    ap.add_argument("new", help="candidate BENCH json")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative regression tolerance (default 0.05 "
+                         "= 5%%)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable single-line JSON output")
+    args = ap.parse_args(argv)
+
+    payloads = []
+    for path in (args.old, args.new):
+        try:
+            with open(path) as f:
+                payloads.append(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_diff: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+    regressions, improvements, drift = diff(
+        payloads[0], payloads[1], threshold=args.threshold)
+
+    if args.json:
+        print(json.dumps({
+            "old": args.old, "new": args.new,
+            "threshold": args.threshold, "regressions": regressions,
+            "improvements": improvements, "drift": drift},
+            sort_keys=True))
+    else:
+        for e in regressions:
+            print(f"REGRESSION {e['key']}: {e['old']:g} -> {e['new']:g} "
+                  f"({e['change']:+.1%}, {e['direction']}-is-better)")
+        for e in improvements:
+            print(f"improved   {e['key']}: {e['old']:g} -> {e['new']:g} "
+                  f"({e['change']:+.1%})")
+        for key in drift:
+            print(f"drift      {key}: present in only one file")
+        verdict = (f"{len(regressions)} regression"
+                   f"{'' if len(regressions) == 1 else 's'} beyond "
+                   f"{args.threshold:.0%}"
+                   if regressions else "bench diff ok")
+        print(verdict)
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -535,6 +535,158 @@ def check_planner_roles():
     return problems
 
 
+def check_metric_names():
+    """[(where, message), ...] — pin every telemetry metric family
+    created anywhere in paddle_tpu/ against telemetry.METRIC_CATALOG
+    (ISSUE 16 satellite), both directions. A mistyped metric name or a
+    drifted label set never raises at runtime: the emitter happily
+    creates a new family, and the reader (read_gauge / fleet.py /
+    dashboards) silently gets None forever. The scan is AST-based
+    (literal first arguments to counter()/gauge()/histogram() calls);
+    dynamically-named families (the roofline gauge loop, the executor's
+    program-attached side-fetch marks, multihost's f-string histograms)
+    carry `dynamic=True` catalog entries, which exempts them from the
+    needs-an-emitter direction. Reader call sites with literal names
+    (read_gauge/read_histogram/read_series/histogram_quantile) are
+    checked too: the read helpers return None on a label-set mismatch,
+    so a reader asking for labels the emitter doesn't write is exactly
+    the silent-drift bug this lint exists to catch."""
+    import ast
+    import os
+
+    from paddle_tpu import telemetry
+
+    catalog = telemetry.METRIC_CATALOG
+    problems = []
+
+    def _literal_labels(node):
+        """A labels= AST node -> tuple of label names, or None when it
+        is not a literal sequence of string constants."""
+        if node is None:
+            return ()
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for el in node.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value,
+                                                               str):
+                    out.append(el.value)
+                else:
+                    return None
+            return tuple(out)
+        return None
+
+    root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "paddle_tpu")
+    emitters = {}   # name -> list of (kind, labels-or-None, where)
+    readers = []    # (fn, name, label-names-or-None, where)
+    read_kinds = {"read_gauge": ("gauge",),
+                  "read_histogram": ("histogram",),
+                  "histogram_quantile": ("histogram",),
+                  "read_series": ("counter", "gauge")}
+    for dirpath, _dirs, files in os.walk(root):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, os.path.dirname(root))
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError as e:
+                    problems.append((rel, f"unparseable: {e}"))
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                attr = (fn.attr if isinstance(fn, ast.Attribute)
+                        else fn.id if isinstance(fn, ast.Name) else None)
+                if attr is None or not node.args:
+                    continue
+                first = node.args[0]
+                name = (first.value
+                        if isinstance(first, ast.Constant)
+                        and isinstance(first.value, str) else None)
+                where = f"{rel}:{node.lineno}"
+                if attr in ("counter", "gauge", "histogram"):
+                    if name is None:
+                        continue  # dynamic name: catalog covers it
+                    labels_node = None
+                    for kw in node.keywords:
+                        if kw.arg == "labels":
+                            labels_node = kw.value
+                    if labels_node is None and len(node.args) >= 3:
+                        labels_node = node.args[2]
+                    emitters.setdefault(name, []).append(
+                        (attr, _literal_labels(labels_node), where))
+                elif attr in read_kinds and name is not None:
+                    # keyword args on the read helpers ARE label names;
+                    # a **dynamic expansion (arg=None) is unverifiable
+                    labelnames = []
+                    for kw in node.keywords:
+                        if kw.arg is None:
+                            labelnames = None
+                            break
+                        labelnames.append(kw.arg)
+                    readers.append((attr, name,
+                                    None if labelnames is None
+                                    else tuple(labelnames), where))
+
+    # direction 1: every literal emitter must match the catalog
+    for name in sorted(emitters):
+        entry = catalog.get(name)
+        for kind, labels, where in emitters[name]:
+            if entry is None:
+                problems.append((
+                    where, f"metric '{name}' ({kind}) is not in "
+                           f"telemetry.METRIC_CATALOG — add it or fix "
+                           f"the typo"))
+                continue
+            if kind != entry["kind"]:
+                problems.append((
+                    where, f"metric '{name}' created as {kind} but "
+                           f"cataloged as {entry['kind']}"))
+            if labels is not None and set(labels) != set(entry["labels"]):
+                problems.append((
+                    where, f"metric '{name}' created with labels "
+                           f"{sorted(labels)} but cataloged with "
+                           f"{sorted(entry['labels'])} — label-set "
+                           f"drift"))
+
+    # direction 2: every non-dynamic catalog entry needs an emitter
+    for name in sorted(catalog):
+        if catalog[name].get("dynamic"):
+            continue
+        if name not in emitters:
+            problems.append((
+                "telemetry.METRIC_CATALOG",
+                f"'{name}' is cataloged but no counter/gauge/histogram "
+                f"call site in paddle_tpu/ creates it — dead entry or "
+                f"renamed emitter"))
+
+    # readers: the silent-None direction
+    for fn, name, labelnames, where in readers:
+        entry = catalog.get(name)
+        if entry is None:
+            problems.append((
+                where, f"{fn}('{name}') reads a metric that is not in "
+                       f"the catalog — returns None forever"))
+            continue
+        if entry["kind"] not in read_kinds[fn]:
+            problems.append((
+                where, f"{fn}('{name}') reads a {entry['kind']} family "
+                       f"— kind mismatch returns None"))
+        if fn != "read_series" and labelnames is not None \
+                and set(labelnames) != set(entry["labels"]):
+            problems.append((
+                where, f"{fn}('{name}') passes labels "
+                       f"{sorted(labelnames)} but the family is labeled "
+                       f"{sorted(entry['labels'])} — the read helper "
+                       f"returns None on this mismatch"))
+    return problems
+
+
 def main():
     problems = check_tables()
     for tname, name in problems:
@@ -563,8 +715,11 @@ def main():
     plroles = check_planner_roles()
     for where, msg in plroles:
         print(f"{where}: {msg}")
+    metrics = check_metric_names()
+    for where, msg in metrics:
+        print(f"{where}: {msg}")
     problems = problems + coll + jit + sparse + embc + pallas + inferp \
-        + servp + plroles
+        + servp + plroles + metrics
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")
