@@ -572,6 +572,32 @@ def _emit(payload, errors=()):
     _ANALYZE_PROG[0] = None
     print(json.dumps(payload))
     sys.stdout.flush()
+    _append_history(payload)
+
+
+def _append_history(payload):
+    """Append the emitted line to the standing BENCH_HISTORY.jsonl ledger
+    (ISSUE 17 satellite) — the series `tools/bench_diff.py --history`
+    gates the BENCH_r* campaign against. Ledger metadata (git sha,
+    timestamp) is passed in via BENCH_GIT_SHA/BENCH_TS by the driver, not
+    computed here — the bench process stays subprocess-free. BENCH_HISTORY
+    names the file (default: BENCH_HISTORY.jsonl next to bench.py);
+    0/off/none disables. Never kills the bench line."""
+    raw = os.environ.get("BENCH_HISTORY", "").strip()
+    if raw.lower() in ("0", "off", "none", "no", "false"):
+        return
+    path = raw or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl")
+    mode = os.environ.get("BENCH_MODE", "resnet")
+    record = {"ts": float(os.environ.get("BENCH_TS") or time.time()),
+              "git_sha": os.environ.get("BENCH_GIT_SHA") or None,
+              "mode": mode, "family": mode.partition("_")[0]}
+    record.update(payload)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(record, default=str) + "\n")
+    except OSError:
+        pass
 
 
 def main_cnn(family, train=True):

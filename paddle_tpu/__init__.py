@@ -57,6 +57,8 @@ from . import inspector
 from . import roofline
 from . import obs_server
 obs_server.maybe_start_from_env()
+from . import sentinel
+sentinel.maybe_start_from_env()
 from .parallel import transpiler
 from .parallel.transpiler import DistributeTranspiler
 

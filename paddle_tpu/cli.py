@@ -690,6 +690,72 @@ def cmd_obs(args):
     return 0 if st_metrics == 200 and st_spans == 200 else 1
 
 
+def cmd_sentinel(args):
+    """Run-sentinel drill: start the supervisor (sentinel.py), inject a
+    planted step-time regression, a loss spike, and a short hang
+    (--smoke), then print the alert ledger and one JSON summary line.
+    Without --smoke, starts the sentinel and holds, supervising whatever
+    the process's telemetry shows (Ctrl-C exits)."""
+    import json
+
+    from paddle_tpu import sentinel as sentinel_mod
+
+    sent = sentinel_mod.start(
+        report_path=args.report,
+        interval_s=args.interval) if sentinel_mod.active() is None \
+        else sentinel_mod.active()
+
+    if not args.smoke:
+        print("sentinel: supervising — Ctrl-C to exit", file=sys.stderr)
+        try:
+            while True:
+                time_mod.sleep(1.0)
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    # 1) anomaly drill: healthy baselines, then a planted step-time
+    #    regression and a loss spike — each must raise exactly one alert
+    for i in range(16):
+        sent.feed("step_time_regression", 0.1 + 0.001 * (i % 3))
+        sentinel_mod.observe_loss(2.5 + 0.01 * (i % 3))
+        sent.feed("loss_spike", 2.5 + 0.01 * (i % 3))
+    a1 = sent.feed("step_time_regression", 0.35)
+    a2 = sent.feed("loss_spike", 30.0)
+
+    # 2) hang drill: a dispatch that sleeps past its deadline, then
+    #    recovers — watchdog must fire AND clear
+    drill = sent.inject_stall(0.8, budget_s=0.3)
+    hang = None
+    deadline = time_mod.time() + 5.0
+    while hang is None and time_mod.time() < deadline:
+        hang = sent.hang_state()
+        time_mod.sleep(0.05)
+    drill.join(timeout=5.0)
+    recovered = sent.hang_state() is None
+
+    for a in sent.alerts():
+        print(f"[alert] {a['rule']} severity={a['severity']} "
+              f"value={a['value']:.4g} z={a['zscore']:.1f} "
+              f"x{a['count']}", file=sys.stderr)
+    if hang is not None:
+        print(f"[hang] program={hang['program']} "
+              f"report={hang['report_path']} "
+              f"recovered={recovered}", file=sys.stderr)
+
+    summary = {
+        "alerts": len(sent.alerts()),
+        "rules_fired": sorted({a["rule"] for a in sent.alerts()}),
+        "hang": {"fired": hang is not None,
+                 "report": hang.get("report_path") if hang else None,
+                 "recovered": recovered},
+    }
+    print(json.dumps(summary, sort_keys=True, default=str))
+    ok = (a1 is not None and a2 is not None
+          and hang is not None and recovered)
+    return 0 if ok else 1
+
+
 def cmd_version(_args):
     import paddle_tpu
     import jax
@@ -1051,6 +1117,21 @@ def main(argv=None):
     p_obs.add_argument("--hold", action="store_true",
                        help="keep serving after the smoke until Ctrl-C")
     p_obs.set_defaults(fn=cmd_obs)
+
+    p_sent = sub.add_parser(
+        "sentinel", help="run sentinel: statistical anomaly alerts + "
+                         "hang watchdog; --smoke injects a stall and a "
+                         "loss spike and prints the alert ledger")
+    p_sent.add_argument("--smoke", action="store_true",
+                        help="inject a planted regression, loss spike "
+                             "and short hang, print the ledger, exit")
+    p_sent.add_argument("--report", default=None,
+                        help="hang report path (default "
+                             "$PADDLE_TPU_SENTINEL_REPORT or "
+                             "paddle_tpu_hang.json)")
+    p_sent.add_argument("--interval", type=float, default=5.0,
+                        help="live poll interval seconds (default 5)")
+    p_sent.set_defaults(fn=cmd_sentinel)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

@@ -596,6 +596,9 @@ class Executor:
             return_numpy: bool = True, use_program_cache: bool = True,
             use_jit: Optional[bool] = None):
         program = program if program is not None else default_main_program()
+        # hang watchdog: a None fast-path unless sentinel.start() ran
+        from . import sentinel as sentinel_mod
+        _tok = sentinel_mod.arm_dispatch(telemetry.program_label(program))
         try:
             return self._run_impl(program, feed, fetch_list, feed_var_name,
                                   fetch_var_name, scope, return_numpy,
@@ -608,6 +611,8 @@ class Executor:
             from . import inspector as inspector_mod
             inspector_mod.notify_crash(self, program, e)
             raise
+        finally:
+            sentinel_mod.disarm_dispatch(_tok)
 
     def run_steps(self, program: Optional[Program] = None, feed_window=None,
                   *, reader=None, steps: Optional[int] = None,
@@ -642,6 +647,8 @@ class Executor:
         side-fetch gauges (_telemetry_fetch_extra) are skipped on the
         window path: they are a per-step observability feature."""
         program = program if program is not None else default_main_program()
+        from . import sentinel as sentinel_mod
+        _tok = sentinel_mod.arm_dispatch(telemetry.program_label(program))
         try:
             return self._run_steps_impl(
                 program, feed_window, reader, steps, fetch_list, scope,
@@ -650,6 +657,8 @@ class Executor:
             from . import inspector as inspector_mod
             inspector_mod.notify_crash(self, program, e)
             raise
+        finally:
+            sentinel_mod.disarm_dispatch(_tok)
 
     def _run_steps_impl(self, program, feed_window, reader, steps,
                         fetch_list, scope, return_numpy, fetch_mode,

@@ -170,6 +170,11 @@ def local_snapshot() -> Dict[str, Any]:
     hist = telemetry.read_histogram("input_stall_seconds") or {}
     hbm = {name: max(telemetry.read_series(name).values() or [0.0])
            for name in ("hbm_bytes_in_use", "hbm_peak_bytes")}
+    # run-sentinel alert counts (ISSUE 17): series keys are
+    # "rule=...,severity=..." — page-severity split out so the straggler
+    # verdict can say WHICH host is alerting, not just which is slow
+    alerts = telemetry.read_series("sentinel_alerts_total")
+    alerts_page = sum(v for k, v in alerts.items() if "severity=page" in k)
     return {
         "host": telemetry._host_index(),
         "steps": sum(telemetry.read_series("executor_steps_total")
@@ -183,6 +188,8 @@ def local_snapshot() -> Dict[str, Any]:
             telemetry.read_gauge("collective_time_seconds") or 0.0,
         "hbm_bytes_in_use": hbm["hbm_bytes_in_use"],
         "hbm_peak_bytes": hbm["hbm_peak_bytes"],
+        "alerts_total": sum(alerts.values()) if alerts else 0.0,
+        "alerts_page": alerts_page,
     }
 
 
@@ -228,11 +235,26 @@ def fleet_snapshot(local: Optional[Dict[str, Any]] = None) \
         d = float(slow.get(key) or 0.0) - _med(vals)
         if d > excess:
             cause, excess = label, d
+    # sentinel alert roll-up: the host with the most alerts, so a skew
+    # verdict can name the host that is also statistically anomalous
+    alert_counts = [float(h.get("alerts_total") or 0.0) for h in hosts]
+    alerting = (hosts[alert_counts.index(max(alert_counts))]
+                if max(alert_counts, default=0.0) > 0 else None)
     out = {
         "hosts": hosts, "n_hosts": len(hosts),
         "median_step_s": med, "max_step_s": mx,
         "step_skew": max(skew, 1.0),
-        "straggler": {"host": slow.get("host", 0), "cause": cause},
+        "straggler": {"host": slow.get("host", 0), "cause": cause,
+                      "alerts_total": float(slow.get("alerts_total")
+                                            or 0.0)},
+        "alerting_host": (None if alerting is None
+                          else {"host": alerting.get("host", 0),
+                                "alerts_total":
+                                    float(alerting.get("alerts_total")
+                                          or 0.0),
+                                "alerts_page":
+                                    float(alerting.get("alerts_page")
+                                          or 0.0)}),
     }
     telemetry.gauge(
         "fleet_step_skew",
@@ -350,10 +372,15 @@ def format_goodput(gp: Optional[Dict[str, Any]]) -> List[str]:
 
 def format_fleet(snap: Dict[str, Any]) -> str:
     s = snap["straggler"]
-    return ("[fleet] hosts {} | step skew {:.2f}x (median {:.4f}s, max "
+    line = ("[fleet] hosts {} | step skew {:.2f}x (median {:.4f}s, max "
             "{:.4f}s) | straggler host {} ({})".format(
                 snap["n_hosts"], snap["step_skew"], snap["median_step_s"],
                 snap["max_step_s"], s["host"], s["cause"]))
+    a = snap.get("alerting_host")
+    if a:
+        line += " | alerting host {} ({:.0f} alert(s))".format(
+            a["host"], a["alerts_total"])
+    return line
 
 
 # --- one-call capture --------------------------------------------------------

@@ -836,12 +836,15 @@ def notify_crash(exe, program, exc) -> Optional[str]:
 
 
 def dump_crash_report(path: Optional[str] = None, *, error=None,
-                      program=None, kind: str = "crash") -> str:
+                      program=None, kind: str = "crash",
+                      extra: Optional[Dict[str, Any]] = None) -> str:
     """Write the flight-recorder JSON crash report. Format (version 1):
     {format, version, kind, ts, host, error{type,message,...}, env (the
     PADDLE_TPU_*/JAX_*/XLA_* vars), flags (full registry dump), steps (the
     ring), events (telemetry ring incl. retrace causes), metrics (local
-    snapshot), program (pprint_program text), probe_stats, grad_audit}."""
+    snapshot), program (pprint_program text), probe_stats, grad_audit}.
+    `extra` merges caller sections into the report before it is written —
+    the sentinel's hang reports add {threads, spans, hang} this way."""
     report: Dict[str, Any] = {
         "format": "paddle_tpu-crash-report", "version": 1, "kind": kind,
         "ts": time.time(),
@@ -901,6 +904,8 @@ def dump_crash_report(path: Optional[str] = None, *, error=None,
             }
         except Exception:
             pass
+    if extra:
+        report.update(extra)
     path = path or _RECORDER.path or "paddle_tpu_crash.json"
     d = os.path.dirname(path)
     if d:
@@ -1010,6 +1015,28 @@ def format_crash_report(report: Dict[str, Any], *,
             nm = f" '{b['name']}'" if b.get("name") else ""
             lines.append(f"  live buffer{nm}: {_fmt_hbm(b.get('nbytes'))} "
                          f"{b.get('dtype')}{b.get('shape')}")
+    hang = report.get("hang") or {}
+    if hang:
+        lines.append(
+            f"hang: program={hang.get('program')} "
+            f"budget={hang.get('budget_s', 0.0):.3g}s "
+            f"waited={hang.get('waited_s', 0.0):.3g}s "
+            f"thread={hang.get('thread')}")
+    threads = report.get("threads") or []
+    if threads:
+        stalled = sum(1 for t in threads if t.get("stalled"))
+        lines.append(f"threads: {len(threads)} captured"
+                     + (f", {stalled} stalled" if stalled else ""))
+        for t in threads:
+            mark = "  ** STALLED **" if t.get("stalled") else ""
+            lines.append(f"  thread '{t.get('name')}' "
+                         f"ident={t.get('ident')}"
+                         f"{' daemon' if t.get('daemon') else ''}{mark}")
+            # stacks are multi-line strings from traceback.format_stack
+            tail = t.get("stack") or []
+            for frame in (tail if t.get("stalled") else tail[-2:]):
+                for ln in frame.splitlines():
+                    lines.append("    " + ln)
     mem = report.get("memory") or {}
     if mem.get("tracker") or mem.get("programs"):
         tr = mem.get("tracker") or {}

@@ -7,11 +7,14 @@ foreground threads:
     /metrics   Prometheus text exposition of the whole registry
     /healthz   liveness verdict: 200 JSON when healthy, 503 when steps
                have stalled (no run/run_window step event within the
-               staleness threshold) or a crash event was recorded;
+               staleness threshold), a crash event was recorded, or the
+               run sentinel's hang watchdog fired (reason=hang);
                "degraded" (still 200) when any model's fast-window SLO
-               burn rate exceeds 1.0
+               burn rate exceeds 1.0 or a page-severity sentinel alert
+               is active
     /spans     recent finished trace spans (tracing.py ring buffer);
                ?n= limits, ?trace_id= filters, ?name= filters
+    /alerts    run-sentinel alert ledger + hang state (sentinel.py)
     /report    roofline/fleet/SLO JSON roll-up
     /          endpoint index
 
@@ -101,9 +104,31 @@ def health_report(max_step_age_s: Optional[float] = None,
     except Exception:
         checks["slo"] = None
 
+    # run sentinel: a detected hang is unhealthy (with a top-level
+    # reason the drills/pagers key on); active page alerts degrade
+    reason = None
+    try:
+        from . import sentinel as sentinel_mod
+        hang = sentinel_mod.hang_state()
+        checks["hang"] = hang
+        if hang is not None:
+            healthy = False
+            reason = "hang"
+        alerts = sentinel_mod.alert_summary(now=now)
+        checks["alerts"] = alerts
+        if alerts.get("active_page", 0) > 0:
+            degraded = True
+    except Exception:
+        checks["hang"] = None
+        checks["alerts"] = None
+
     status = ("unhealthy" if not healthy
               else "degraded" if degraded else "ok")
-    return {"status": status, "healthy": healthy, "checks": checks}
+    out: Dict[str, object] = {"status": status, "healthy": healthy,
+                              "checks": checks}
+    if reason is not None:
+        out["reason"] = reason
+    return out
 
 
 def _report_payload() -> Dict[str, object]:
@@ -129,6 +154,13 @@ def _report_payload() -> Dict[str, object]:
         if v is not None:
             roofline_gauges[gname] = v
     out["roofline"] = roofline_gauges or None
+    try:
+        from . import sentinel as sentinel_mod
+        out["sentinel"] = {"enabled": sentinel_mod.enabled(),
+                           "summary": sentinel_mod.alert_summary(),
+                           "hang": sentinel_mod.hang_state()}
+    except Exception:
+        out["sentinel"] = None
     snap = telemetry.snapshot()
     out["metrics_families"] = len(snap)
     out["spans_buffered"] = len(tracing.recent_spans())
@@ -179,11 +211,15 @@ class _Handler(BaseHTTPRequestHandler):
                     trace_id=q.get("trace_id", [None])[0])
                 self._send_json(200, {"spans": spans,
                                       "enabled": tracing.enabled()})
+            elif route == "/alerts":
+                from . import sentinel as sentinel_mod
+                self._send_json(200, sentinel_mod.alerts_payload())
             elif route == "/report":
                 self._send_json(200, _report_payload())
             elif route == "/":
                 self._send_json(200, {"endpoints": [
-                    "/metrics", "/healthz", "/spans", "/report"]})
+                    "/metrics", "/healthz", "/spans", "/alerts",
+                    "/report"]})
             else:
                 self._send_json(404, {"error": f"no route {route}"})
         except BrokenPipeError:

@@ -60,6 +60,9 @@ class DoubleBufferedFeeder:
         self._wqueue: Optional[queue.Queue] = None
         self._wstop = threading.Event()
         self._wkey = None
+        # consumer-side trace context the builder thread adopts
+        # (tracing.capture_context handle; None when no span is live)
+        self._wctx = None
 
     def _produce(self):
         try:
@@ -146,7 +149,9 @@ class DoubleBufferedFeeder:
             self._consumer = None
             self._count_dropped(len(feeds))
             raise StopIteration from None
-        window = self._stack_window(feeds, device, sparse)
+        from .. import tracing
+        with tracing.span("input_window_build", batches=k):
+            window = self._stack_window(feeds, device, sparse)
         telemetry.counter(
             "input_windows_total",
             "stacked k-step windows delivered by prefetch feeders").inc()
@@ -195,6 +200,7 @@ class DoubleBufferedFeeder:
                     continue
             return False
 
+        from .. import tracing
         try:
             it = iter(self)
             while not wstop.is_set():
@@ -203,17 +209,30 @@ class DoubleBufferedFeeder:
                     while len(feeds) < k:
                         feeds.append(next(it))
                 except StopIteration:
-                    self._count_dropped(len(feeds))
-                    _put(_STOP)
+                    # the drop count rides the stop marker so the CONSUMER
+                    # books it at the pull that raises StopIteration —
+                    # counting here would race the caller's reads of the
+                    # dropped-batches counter (the builder runs ahead)
+                    _put((_STOP, len(feeds)))
                     return
-                if not _put(self._stack_window(feeds, device,
-                                               sparse_slots)):
+                # adopt the consumer thread's captured trace context so
+                # the build span is a child of the owning step trace, not
+                # an orphan root minted on this thread
+                with tracing.adopt(self._wctx):
+                    with tracing.span("input_window_build", batches=k):
+                        window = self._stack_window(feeds, device,
+                                                    sparse_slots)
+                if not _put(window):
                     return
         except BaseException as e:        # surface in the consumer
             _put(e)
 
     def _next_window_prefetched(self, k: int, device, sparse_slots=None):
         from .. import telemetry
+        from .. import tracing
+        # refreshed every pull: the builder parents its next build span
+        # under whatever step trace is live on the consumer right now
+        self._wctx = tracing.capture_context()
         key = (k, device, sparse_slots)
         if self._wthread is None or self._wkey != key:
             self._stop_windows()
@@ -226,7 +245,8 @@ class DoubleBufferedFeeder:
                 daemon=True)
             self._wthread.start()
         item = self._wqueue.get()
-        if item is _STOP:
+        if type(item) is tuple and len(item) == 2 and item[0] is _STOP:
+            self._count_dropped(item[1])
             self._wthread.join()
             self._wthread = None
             self._wkey = None

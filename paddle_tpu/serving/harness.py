@@ -75,11 +75,19 @@ def run_load(batcher, make_feed: Callable[[int, int], Dict],
 
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(clients)]
+    # hang watchdog over the whole load phase (a wedged engine shows up
+    # as a sentinel hang report, not a silent stuck join); no-op fast
+    # path when the sentinel is off
+    from .. import sentinel as sentinel_mod
+    _tok = sentinel_mod.arm_dispatch(f"serving_load:{label}")
     t0 = time.monotonic()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sentinel_mod.disarm_dispatch(_tok)
     wall_s = max(time.monotonic() - t0, 1e-9)
 
     submitted = ok[0] + shed[0] + timeouts[0] + errors[0]

@@ -870,4 +870,12 @@ METRIC_CATALOG = {
         "counter", (), "spans evicted from the trace ring buffer"),
     "obs_requests_total": _m("counter", ("endpoint",),
                              "observability endpoint scrapes"),
+    # run sentinel
+    "sentinel_alerts_total": _m(
+        "counter", ("rule", "severity"),
+        "deduplicated sentinel alerts, by rule and severity"),
+    "sentinel_hangs_total": _m("counter", (),
+                               "hang-watchdog deadline expiries"),
+    "train_loss": _m("gauge", ("program",),
+                     "training loss observed by the run sentinel"),
 }

@@ -316,6 +316,47 @@ def span(name: str, **attrs):
     return _SpanCtx(name, attrs or None)
 
 
+def capture_context():
+    """Snapshot this thread's innermost live span as a handle a worker
+    thread can `adopt()` — how DoubleBufferedFeeder's builder threads
+    parent their prefetch spans under the owning step trace instead of
+    minting orphan roots. None (and adopt(None) is a no-op) when nothing
+    is live."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+class _AdoptCtx:
+    __slots__ = ("ctx", "pushed")
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.pushed = False
+
+    def __enter__(self):
+        if self.ctx is not None and getattr(self.ctx, "sampled", False):
+            _stack().append(self.ctx)
+            self.pushed = True
+        return self.ctx
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.pushed:
+            st = _stack()
+            if st and st[-1] is self.ctx:
+                st.pop()
+            elif self.ctx in st:
+                st.remove(self.ctx)
+        return False
+
+
+def adopt(ctx):
+    """Context manager: make a `capture_context()` handle (taken on
+    another thread) this thread's current span, so `span()`/`start_span`
+    children recorded here join the owning trace. The adopted span is
+    NOT ended on exit — its owner ends it."""
+    return _AdoptCtx(ctx)
+
+
 def record_span(name: str, start: float, end: float, parent=None,
                 trace_id: Optional[str] = None,
                 attrs: Optional[Dict] = None):

@@ -116,6 +116,136 @@ def test_main_json_output(tmp_path, capsys):
     assert doc["drift"] == ["extra"]
 
 
+# --- --history ledger mode (ISSUE 17 satellite) ------------------------------
+
+def _history(tmp_path, rows):
+    p = tmp_path / "BENCH_HISTORY.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return str(p)
+
+
+def _entry(mode, value, p99=10.0, **extra):
+    return {"ts": 1.0, "git_sha": "abc", "mode": mode,
+            "family": mode.partition("_")[0], "value": value,
+            "p99_ms": p99, **extra}
+
+
+def test_history_flat_ledger_exits_zero(tmp_path, capsys):
+    path = _history(tmp_path, [
+        _entry("fc", 100.0), _entry("fc", 101.0), _entry("fc", 100.5),
+        _entry("resnet", 50.0), _entry("resnet", 50.2),
+    ])
+    assert bench_diff.main(["--history", path]) == 0
+    assert "bench history ok (2 groups compared)" in \
+        capsys.readouterr().out
+
+
+def test_history_planted_regression_exits_nonzero(tmp_path, capsys):
+    path = _history(tmp_path, [
+        _entry("fc", 100.0), _entry("fc", 101.0),
+        _entry("fc", 70.0, p99=19.0),   # value -30%, p99 +89%
+    ])
+    assert bench_diff.main(["--history", path]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION fc/fc value" in out
+    assert "REGRESSION fc/fc p99_ms" in out
+
+
+def test_history_compares_median_not_last(tmp_path):
+    # priors 100, 100, 900 (one wild outlier): median 100, so the
+    # newest 98 is within threshold — mean-based gating would fail it
+    path = _history(tmp_path, [
+        _entry("fc", 100.0), _entry("fc", 100.0), _entry("fc", 900.0),
+        _entry("fc", 98.0),
+    ])
+    assert bench_diff.main(["--history", path]) == 0
+
+
+def test_history_single_entry_group_skipped(tmp_path, capsys):
+    path = _history(tmp_path, [_entry("fc", 100.0)])
+    assert bench_diff.main(["--history", path]) == 0
+    assert "0 groups compared" in capsys.readouterr().out
+
+
+def test_history_groups_isolated_by_mode(tmp_path):
+    # fc_infer's 30 must not be compared against fc's 100s
+    path = _history(tmp_path, [
+        _entry("fc", 100.0), _entry("fc", 101.0),
+        _entry("fc_infer", 31.0), _entry("fc_infer", 30.0),
+    ])
+    assert bench_diff.main(["--history", path]) == 0
+
+
+def test_history_meta_keys_not_compared(tmp_path):
+    rows = [_entry("fc", 100.0), _entry("fc", 100.0)]
+    rows[-1]["ts"] = 9_999.0          # wildly different timestamp
+    rows[-1]["git_sha"] = "zzz"
+    path = _history(tmp_path, rows)
+    assert bench_diff.main(["--history", path]) == 0
+
+
+def test_history_threshold_and_json(tmp_path, capsys):
+    path = _history(tmp_path, [
+        _entry("fc", 100.0), _entry("fc", 92.0)])
+    assert bench_diff.main(["--history", path, "--threshold", "0.10",
+                            "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["regressions"] == []
+    assert doc["groups"] == [{"mode": "fc", "family": "fc",
+                              "entries": 2}]
+    assert bench_diff.main(["--history", path]) == 1
+    capsys.readouterr()
+
+
+def test_history_missing_or_garbage_exits_two(tmp_path, capsys):
+    assert bench_diff.main(
+        ["--history", str(tmp_path / "nope.jsonl")]) == 2
+    garbage = tmp_path / "junk.jsonl"
+    garbage.write_text("{not json\n")
+    assert bench_diff.main(["--history", str(garbage)]) == 2
+
+
+def test_two_file_mode_requires_both_files(capsys):
+    assert bench_diff.main([]) == 2
+
+
+def test_bench_emit_appends_history(tmp_path, monkeypatch, capsys):
+    """bench._emit must append its JSON line (plus the driver-passed
+    git sha/timestamp meta) to the ledger, and a write failure must
+    never kill the bench line."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_history_under_test",
+        str(Path(__file__).resolve().parent.parent / "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ledger = tmp_path / "hist.jsonl"
+    monkeypatch.setenv("BENCH_HISTORY", str(ledger))
+    monkeypatch.setenv("BENCH_MODE", "fc")
+    monkeypatch.setenv("BENCH_GIT_SHA", "deadbeef")
+    monkeypatch.setenv("BENCH_TS", "1234.5")
+    bench._emit({"metric": "fc", "value": None, "unit": None})
+    capsys.readouterr()
+    rec = json.loads(ledger.read_text().strip())
+    assert rec["git_sha"] == "deadbeef" and rec["ts"] == 1234.5
+    assert rec["mode"] == "fc" and rec["family"] == "fc"
+    assert rec["metric"] == "fc"
+
+    # disabled: no write
+    monkeypatch.setenv("BENCH_HISTORY", "0")
+    bench._emit({"metric": "fc", "value": None, "unit": None})
+    out = capsys.readouterr().out
+    assert json.loads(out.strip().splitlines()[-1])["metric"] == "fc"
+    assert len(ledger.read_text().strip().splitlines()) == 1
+
+    # unwritable path: the bench line still comes out
+    monkeypatch.setenv("BENCH_HISTORY", str(tmp_path / "no" / "dir.jsonl"))
+    bench._emit({"metric": "fc", "value": None, "unit": None})
+    assert json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])["metric"] == "fc"
+
+
 def test_cli_subprocess(tmp_path):
     old = _write(tmp_path, "a.json", {"value": 100.0})
     new = _write(tmp_path, "b.json", {"value": 100.0})

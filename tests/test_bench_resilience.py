@@ -30,6 +30,9 @@ def bench():
 @pytest.fixture(autouse=True)
 def _no_sleep(bench, monkeypatch):
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # _emit appends to the BENCH_HISTORY.jsonl ledger (ISSUE 17); keep
+    # test emissions out of the repo's standing ledger
+    monkeypatch.setenv("BENCH_HISTORY", "0")
 
 
 class _FlakyStep:

@@ -360,7 +360,8 @@ class TestFleetSnapshot:
         assert snap["n_hosts"] == 1
         assert snap["step_skew"] == 1.0
         assert snap["median_step_s"] == pytest.approx(0.25)
-        assert snap["straggler"] == {"host": 3, "cause": "compute"}
+        assert snap["straggler"] == {"host": 3, "cause": "compute",
+                                     "alerts_total": 0.0}
         assert telemetry.read_gauge("fleet_step_skew") == 1.0
         assert "straggler host 3 (compute)" in fleet.format_fleet(snap)
 
@@ -399,4 +400,5 @@ class TestFleetSnapshot:
         # skew = 0.2 / median(0.1, 0.2)
         for r in results:
             assert r["skew"] == pytest.approx(0.2 / 0.15)
-            assert r["straggler"] == {"host": 1, "cause": "infeed"}
+            assert r["straggler"] == {"host": 1, "cause": "infeed",
+                                      "alerts_total": 0.0}

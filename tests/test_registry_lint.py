@@ -362,6 +362,70 @@ def test_metric_lint_catches_dead_catalog_entry(monkeypatch):
                for _, m in problems), problems
 
 
+def test_alert_rules_consistent():
+    """ISSUE 17 satellite: every sentinel.ALERT_CATALOG rule must watch
+    a cataloged telemetry metric with a compatible label set, keep its
+    schema inside the sentinel's vocabularies, and the alert counter's
+    own catalog entry must carry exactly {rule, severity} — either
+    direction drifting means a rule that silently never fires."""
+    problems = _load_checker().check_alert_rules()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_alert_lint_catches_bogus_metric(monkeypatch):
+    """Sanity: a rule watching a metric the catalog doesn't know trips
+    the can-never-fire direction at the rule's name."""
+    from paddle_tpu import sentinel
+
+    checker = _load_checker()
+    monkeypatch.setitem(
+        sentinel.ALERT_CATALOG, "phantom_rule",
+        dict(sentinel.ALERT_CATALOG["loss_spike"],
+             metric="definitely_not_a_metric"))
+    problems = checker.check_alert_rules()
+    assert any("phantom_rule" in w and "never fire" in m
+               for w, m in problems), problems
+
+
+def test_alert_lint_catches_phantom_label_filter(monkeypatch):
+    """Sanity: a label filter naming a label the watched family doesn't
+    have would drop every sample — the lint must see it."""
+    from paddle_tpu import sentinel
+
+    checker = _load_checker()
+    monkeypatch.setitem(
+        sentinel.ALERT_CATALOG, "slo_fast_burn",
+        dict(sentinel.ALERT_CATALOG["slo_fast_burn"],
+             label_filter={"phantom": "x"}))
+    problems = checker.check_alert_rules()
+    assert any("slo_fast_burn" in w and "phantom" in m
+               for w, m in problems), problems
+
+
+def test_alert_lint_catches_schema_drift(monkeypatch):
+    """Sanity: direction/severity/reducer outside the vocabularies and
+    a drifted sentinel_alerts_total label set all trip."""
+    from paddle_tpu import sentinel, telemetry
+
+    checker = _load_checker()
+    monkeypatch.setitem(
+        sentinel.ALERT_CATALOG, "loss_spike",
+        dict(sentinel.ALERT_CATALOG["loss_spike"], direction="sideways"))
+    problems = checker.check_alert_rules()
+    assert any("sideways" in m for _, m in problems), problems
+
+    monkeypatch.setitem(
+        sentinel.ALERT_CATALOG, "loss_spike",
+        dict(sentinel.ALERT_CATALOG["loss_spike"], direction="high"))
+    orig = telemetry.METRIC_CATALOG["sentinel_alerts_total"]
+    monkeypatch.setitem(
+        telemetry.METRIC_CATALOG, "sentinel_alerts_total",
+        dict(orig, labels=("rule",)))
+    problems = checker.check_alert_rules()
+    assert any("sentinel_alerts_total" in m and "severity" in m
+               for _, m in problems), problems
+
+
 def test_metric_lint_catches_reader_label_drift(monkeypatch):
     """A reader passing a label set the emitter doesn't write is the
     silent-None bug: read_gauge call sites must match the catalog."""

@@ -1,0 +1,511 @@
+"""Run sentinel (ISSUE 17): statistical anomaly detection over live
+telemetry, hang forensics around executor dispatches, and the surfacing
+endpoints.
+
+The acceptance properties pinned here: a planted step-time regression
+and a planted loss spike each raise exactly ONE deduplicated alert (in
+the ledger, in sentinel_alerts_total, and over HTTP in /alerts); healthy
+series raise none; cooldown suppresses repeats; an injected stall
+produces a hang report containing the stalled thread's stack and flips
+/healthz to 503 with reason=hang within the deadline, and the verdict
+recovers cleanly on disarm; the `inspect` CLI renders the hang report;
+fleet snapshots carry per-host alert counts; and the trace
+capture/adopt handle parents window-builder prefetch spans under the
+owning step trace.
+"""
+
+import http.client
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import executor as executor_mod
+from paddle_tpu import fleet, inspector, obs_server, sentinel, telemetry
+from paddle_tpu import tracing
+from paddle_tpu.reader.pipeline import DoubleBufferedFeeder
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sentinel_state():
+    telemetry.reset()
+    tracing.reset()
+    sentinel.reset()
+    yield
+    sentinel.reset()
+    obs_server.stop()
+    telemetry.reset()
+    tracing.reset()
+
+
+def _warm(s, rule, base=0.1, n=16, jitter=0.001):
+    """Feed a healthy series (small deterministic jitter) past warmup."""
+    for i in range(n):
+        assert s.feed(rule, base + jitter * (i % 3)) is None
+
+
+# --- anomaly detection -------------------------------------------------------
+
+def test_healthy_series_raise_no_alerts():
+    s = sentinel.Sentinel()
+    _warm(s, "step_time_regression", base=0.1, n=64)
+    _warm(s, "loss_spike", base=2.5, n=64, jitter=0.01)
+    assert s.alerts() == []
+    assert telemetry.read_series("sentinel_alerts_total") == {}
+
+
+def test_planted_step_time_regression_raises_exactly_one_alert():
+    s = sentinel.Sentinel()
+    _warm(s, "step_time_regression")
+    a = s.feed("step_time_regression", 0.35)
+    assert a is not None and a["rule"] == "step_time_regression"
+    assert a["severity"] == "warn" and a["zscore"] > 4.0
+    # the regression persists across following samples: same incident,
+    # still one ledger entry, still one counter increment
+    for v in (0.36, 0.34, 0.4):
+        assert s.feed("step_time_regression", v) is None
+    ledger = s.alerts()
+    assert len(ledger) == 1
+    assert ledger[0]["count"] == 4
+    series = telemetry.read_series("sentinel_alerts_total")
+    assert series == {"rule=step_time_regression,severity=warn": 1.0}
+    kinds = [e["rule"] for e in telemetry.recent_events(kind="alert")]
+    assert kinds == ["step_time_regression"]
+
+
+def test_planted_loss_spike_raises_one_page_alert():
+    s = sentinel.Sentinel()
+    _warm(s, "loss_spike", base=2.5, jitter=0.01)
+    a = s.feed("loss_spike", 30.0)
+    assert a is not None and a["severity"] == "page"
+    assert s.feed("loss_spike", 28.0) is None
+    assert telemetry.read_series("sentinel_alerts_total") == {
+        "rule=loss_spike,severity=page": 1.0}
+
+
+def test_warmup_gates_alerting():
+    s = sentinel.Sentinel()
+    # fewer than `warmup` samples: even a wild value cannot alert
+    for v in (0.1, 0.1, 0.1, 50.0):
+        assert s.feed("step_time_regression", v) is None
+
+
+def test_low_direction_rule_fires_on_drop_only():
+    s = sentinel.Sentinel()
+    _warm(s, "duty_cycle_drop", base=0.9, n=16)
+    assert s.feed("duty_cycle_drop", 0.95) is None   # up is fine
+    a = s.feed("duty_cycle_drop", 0.2)
+    assert a is not None and a["rule"] == "duty_cycle_drop"
+
+
+def test_cooldown_suppresses_then_expires():
+    s = sentinel.Sentinel()
+    t0 = 1_000_000.0
+    for i in range(16):
+        s.feed("step_time_regression", 0.1 + 0.001 * (i % 3), now=t0 + i)
+    assert s.feed("step_time_regression", 0.5, now=t0 + 20) is not None
+    # within the 60s cooldown: deduped
+    assert s.feed("step_time_regression", 0.6, now=t0 + 40) is None
+    assert len(s.alerts()) == 1
+    # past the cooldown: a NEW incident
+    a = s.feed("step_time_regression", 5.0, now=t0 + 200)
+    assert a is not None
+    assert len(s.alerts()) == 2
+    assert telemetry.read_series("sentinel_alerts_total") == {
+        "rule=step_time_regression,severity=warn": 2.0}
+
+
+def test_min_value_gates_slo_burn_rule():
+    s = sentinel.Sentinel()
+    # statistically huge z but absolute burn < 1.0: budget not being
+    # overspent, stay quiet
+    for _ in range(16):
+        assert s.feed("slo_fast_burn", 0.01) is None
+    assert s.feed("slo_fast_burn", 0.5) is None
+    for _ in range(8):
+        s.feed("slo_fast_burn", 0.5)
+    assert s.feed("slo_fast_burn", 3.0) is not None
+
+
+def test_poll_reads_live_gauges_with_label_filter():
+    s = sentinel.Sentinel()
+    gauge = telemetry.gauge("executor_last_step_seconds",
+                            "wall seconds of the latest step")
+    burn = telemetry.gauge("slo_burn_rate",
+                           "error-budget burn rate by window",
+                           labels=("model", "window"))
+    for i in range(16):
+        gauge.set(0.1 + 0.001 * (i % 3))
+        burn.labels(model="m", window="fast").set(1.5 + 0.01 * (i % 3))
+        burn.labels(model="m", window="slow").set(0.1)
+        assert s.poll(now=1_000_000.0 + i) == []
+    gauge.set(0.4)
+    burn.labels(model="m", window="fast").set(9.0)
+    fired = s.poll(now=1_000_100.0)
+    assert sorted(a["rule"] for a in fired) == ["slo_fast_burn",
+                                               "step_time_regression"]
+    # the slow-window series was filtered out the whole time: no rule
+    # ever saw 0.1
+    assert all(a["value"] != 0.1 for a in fired)
+
+
+def test_observe_loss_feeds_the_loss_rule_via_poll():
+    s = sentinel.Sentinel()
+    for i in range(16):
+        sentinel.observe_loss(2.5 + 0.01 * (i % 3))
+        s.poll(now=1_000_000.0 + i)
+    sentinel.observe_loss(40.0)
+    fired = s.poll(now=1_000_050.0)
+    assert [a["rule"] for a in fired] == ["loss_spike"]
+
+
+# --- hang watchdog -----------------------------------------------------------
+
+def test_inject_stall_dumps_report_and_recovers(tmp_path):
+    path = str(tmp_path / "hang.json")
+    s = sentinel.Sentinel(report_path=path)
+    drill = s.inject_stall(0.6, budget_s=0.1)
+    deadline = time.time() + 5.0
+    while s.hang_state() is None and time.time() < deadline:
+        s.check_hangs()
+        time.sleep(0.02)
+    hang = s.hang_state()
+    assert hang is not None and hang["reason"] == "hang"
+    assert hang["program"] == "injected_stall"
+
+    report = inspector.read_crash_report(path)
+    assert report["kind"] == "hang"
+    assert "hang deadline" in report["error"]["message"]
+    stalled = [t for t in report["threads"] if t["stalled"]]
+    assert len(stalled) == 1
+    assert any("_stalled_dispatch" in ln for ln in stalled[0]["stack"])
+    assert telemetry.read_series("sentinel_hangs_total") == {"": 1.0}
+    assert telemetry.recent_events(kind="hang")
+
+    # clean disarm after recovery: the stalled dispatch returns and the
+    # verdict clears without a restart
+    drill.join(timeout=5.0)
+    assert s.hang_state() is None
+    assert telemetry.recent_events(kind="hang_recovered")
+
+
+def test_hang_report_renders_via_inspect_cli(tmp_path):
+    path = str(tmp_path / "hang.json")
+    s = sentinel.Sentinel(report_path=path)
+    drill = s.inject_stall(0.5, budget_s=0.05)
+    deadline = time.time() + 5.0
+    while s.hang_state() is None and time.time() < deadline:
+        s.check_hangs()
+        time.sleep(0.02)
+    drill.join(timeout=5.0)
+    text = inspector.format_crash_report(
+        inspector.read_crash_report(path))
+    assert "kind=hang" in text
+    assert "STALLED" in text
+    assert "_stalled_dispatch" in text
+
+
+def test_healthz_flips_503_reason_hang_and_recovers(tmp_path):
+    srv = obs_server.start(port=0)
+    s = sentinel.start(report_path=str(tmp_path / "hang.json"),
+                       interval_s=999.0, watch_tick_s=0.02)
+
+    def get(route):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        try:
+            conn.request("GET", route)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read())
+        finally:
+            conn.close()
+
+    st, rep = get("/healthz")
+    assert st == 200 and "reason" not in rep
+    drill = s.inject_stall(1.0, budget_s=0.1)
+    deadline = time.time() + 5.0
+    while s.hang_state() is None and time.time() < deadline:
+        time.sleep(0.02)
+    st, rep = get("/healthz")
+    assert st == 503
+    assert rep["reason"] == "hang"
+    assert rep["checks"]["hang"]["program"] == "injected_stall"
+    drill.join(timeout=5.0)
+    st, rep = get("/healthz")
+    assert st == 200 and rep["healthy"]
+
+
+def test_alerts_endpoint_serves_ledger_and_summary():
+    srv = obs_server.start(port=0)
+    s = sentinel.start(interval_s=999.0)
+    _warm(s, "step_time_regression")
+    s.feed("step_time_regression", 0.5)
+    s.feed("step_time_regression", 0.55)   # deduped
+
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    try:
+        conn.request("GET", "/alerts")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        doc = json.loads(resp.read())
+    finally:
+        conn.close()
+    assert doc["enabled"]
+    assert len(doc["alerts"]) == 1
+    assert doc["alerts"][0]["rule"] == "step_time_regression"
+    assert doc["alerts"][0]["count"] == 2
+    assert doc["summary"]["total"] == 1
+    assert "loss_spike" in doc["rules"]
+
+
+def test_active_page_alert_degrades_healthz():
+    s = sentinel.start(interval_s=999.0)
+    _warm(s, "loss_spike", base=2.5, jitter=0.01)
+    s.feed("loss_spike", 30.0)
+    rep = obs_server.health_report()
+    assert rep["healthy"] and rep["status"] == "degraded"
+    assert rep["checks"]["alerts"]["active_page"] == 1
+
+
+def test_healthz_unaffected_when_sentinel_off():
+    rep = obs_server.health_report()
+    assert rep["status"] == "ok"
+    assert rep["checks"]["alerts"]["total"] == 0
+    assert rep["checks"]["hang"] is None
+
+
+# --- executor integration ----------------------------------------------------
+
+def test_executor_dispatches_arm_the_watchdog():
+    s = sentinel.start(interval_s=999.0, watch_tick_s=999.0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with executor_mod.scope_guard(executor_mod.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((3, 4), np.float32)},
+                fetch_list=[y])
+    assert s.dispatches_total >= 2      # startup + main
+    assert s._dispatches == {}          # all disarmed
+    assert s.hang_state() is None
+
+
+# --- fleet integration -------------------------------------------------------
+
+def test_fleet_snapshot_carries_alert_counts():
+    snap = fleet.local_snapshot()
+    assert snap["alerts_total"] == 0.0 and snap["alerts_page"] == 0.0
+
+    s = sentinel.Sentinel()
+    _warm(s, "loss_spike", base=2.5, jitter=0.01)
+    s.feed("loss_spike", 30.0)
+    snap = fleet.local_snapshot()
+    assert snap["alerts_total"] == 1.0
+    assert snap["alerts_page"] == 1.0
+
+    fs = fleet.fleet_snapshot()
+    assert fs["alerting_host"] == {"host": 0, "alerts_total": 1.0,
+                                   "alerts_page": 1.0}
+    assert fs["straggler"]["alerts_total"] == 1.0
+    assert "alerting host 0" in fleet.format_fleet(fs)
+
+
+def test_fleet_snapshot_no_alerting_host_when_quiet():
+    fs = fleet.fleet_snapshot()
+    assert fs["alerting_host"] is None
+    assert "alerting host" not in fleet.format_fleet(fs)
+
+
+# --- trace-context propagation -----------------------------------------------
+
+def test_capture_adopt_parents_cross_thread_spans():
+    tracing.enable()
+    with tracing.span("step") as step:
+        ctx = tracing.capture_context()
+        assert ctx is step
+
+        def worker():
+            with tracing.adopt(ctx):
+                with tracing.span("child"):
+                    pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    spans = {d["name"]: d for d in tracing.recent_spans()}
+    assert spans["child"]["parent_id"] == spans["step"]["span_id"]
+    assert spans["child"]["trace_id"] == spans["step"]["trace_id"]
+
+
+def test_capture_context_none_and_adopt_noop():
+    tracing.enable()
+    assert tracing.capture_context() is None
+    with tracing.adopt(None):
+        with tracing.span("root"):
+            pass
+    (root,) = tracing.recent_spans(name="root")
+    assert root["parent_id"] is None
+
+
+def test_window_builder_spans_join_owning_trace():
+    tracing.enable()
+
+    def reader():
+        def gen():
+            for i in range(8):
+                yield {"x": np.full((2, 3), i, np.float32)}
+        return gen()
+
+    feeder = DoubleBufferedFeeder(reader, window_prefetch=2)
+    try:
+        with tracing.span("train_step") as step:
+            feeder.next_window(2)
+            # the builder records asynchronously; wait for the span
+            deadline = time.time() + 5.0
+            while (not tracing.recent_spans(name="input_window_build")
+                   and time.time() < deadline):
+                time.sleep(0.01)
+        builds = tracing.recent_spans(name="input_window_build")
+        assert builds, "window-builder recorded no spans"
+        assert builds[0]["trace_id"] == step.trace_id
+        assert builds[0]["parent_id"] == step.span_id
+    finally:
+        feeder.stop()
+
+
+def test_sync_window_build_span_is_child_of_caller():
+    tracing.enable()
+
+    def reader():
+        def gen():
+            for i in range(4):
+                yield {"x": np.full((2, 3), i, np.float32)}
+        return gen()
+
+    feeder = DoubleBufferedFeeder(reader)   # window_prefetch=1: sync
+    try:
+        with tracing.span("train_step") as step:
+            feeder.next_window(2)
+        (build,) = tracing.recent_spans(name="input_window_build")
+        assert build["parent_id"] == step.span_id
+    finally:
+        feeder.stop()
+
+
+# --- lifecycle / CLI ---------------------------------------------------------
+
+def test_singleton_start_stop_and_env(monkeypatch):
+    assert sentinel.active() is None
+    monkeypatch.setenv("PADDLE_TPU_SENTINEL", "1")
+    s = sentinel.maybe_start_from_env()
+    assert s is not None and sentinel.active() is s
+    assert sentinel.start() is s        # idempotent
+    sentinel.stop()
+    assert sentinel.active() is None
+    monkeypatch.setenv("PADDLE_TPU_SENTINEL", "0")
+    assert sentinel.maybe_start_from_env() is None
+
+
+def test_hang_budget_env_override(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_SENTINEL_HANG_S", "123.5")
+    s = sentinel.Sentinel()
+    tok = s.arm("p0")
+    assert s._dispatches[tok]["budget_s"] == 123.5
+    s.disarm(tok)
+
+
+def test_hang_budget_scales_with_rolling_step_time():
+    s = sentinel.Sentinel()
+    tok = s.arm("p0")
+    assert s._dispatches[tok]["budget_s"] == sentinel.HANG_FLOOR_S
+    s.disarm(tok)
+    telemetry.gauge("executor_last_step_seconds",
+                    "wall seconds of the latest step").set(10.0)
+    tok = s.arm("p0")
+    assert s._dispatches[tok]["budget_s"] == pytest.approx(200.0)
+    s.disarm(tok)
+
+
+def test_cmd_sentinel_smoke(tmp_path, capsys):
+    from paddle_tpu import cli
+    rc = cli.main(["sentinel", "--smoke",
+                   "--report", str(tmp_path / "hang.json")])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    doc = json.loads(out)
+    assert doc["hang"]["fired"] and doc["hang"]["recovered"]
+    assert sorted(doc["rules_fired"]) == ["loss_spike",
+                                         "step_time_regression"]
+    assert (tmp_path / "hang.json").exists()
+
+
+# --- subprocess drill --------------------------------------------------------
+
+_HANG_DRILL = r"""
+import json, sys, time
+import http.client
+
+from paddle_tpu import obs_server, sentinel
+
+srv = obs_server.start(port=0)
+sent = sentinel.start(report_path=sys.argv[1], interval_s=999.0,
+                      watch_tick_s=0.02)
+drill = sent.inject_stall(1.2, budget_s=0.15)
+
+def get(route):
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    try:
+        conn.request("GET", route)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+deadline = time.time() + 10.0
+while sent.hang_state() is None and time.time() < deadline:
+    time.sleep(0.02)
+st_hung, rep_hung = get("/healthz")
+drill.join(timeout=10.0)
+st_rec, rep_rec = get("/healthz")
+print(json.dumps({
+    "hung_status": st_hung, "hung_reason": rep_hung.get("reason"),
+    "recovered_status": st_rec,
+    "hang_cleared": sent.hang_state() is None}))
+"""
+
+
+def test_subprocess_hang_drill(tmp_path):
+    """Full-fidelity drill in a fresh process: injected stall -> hang
+    report with the stalled thread's stack on disk, /healthz 503 with
+    reason=hang within the deadline, clean recovery after disarm."""
+    import os
+    report = tmp_path / "hang.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=str(Path(__file__).resolve().parent.parent))
+    env.pop("PADDLE_TPU_SENTINEL", None)
+    env.pop("PADDLE_TPU_OBS_PORT", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _HANG_DRILL, str(report)],
+        cwd=str(Path(__file__).resolve().parent.parent),
+        env=env, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["hung_status"] == 503
+    assert doc["hung_reason"] == "hang"
+    assert doc["recovered_status"] == 200
+    assert doc["hang_cleared"]
+
+    rep = json.loads(report.read_text())
+    assert rep["format"] == "paddle_tpu-crash-report"
+    assert rep["kind"] == "hang"
+    stalled = [t for t in rep["threads"] if t["stalled"]]
+    assert stalled and any("_stalled_dispatch" in ln
+                           for ln in stalled[0]["stack"])

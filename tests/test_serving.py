@@ -447,7 +447,7 @@ def test_bench_serving_mode_json_line():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_MODE="serving",
                BENCH_ROOFLINE="0", BENCH_PERF="0", BENCH_SERVE_CLIENTS="2",
-               BENCH_SERVE_REQUESTS="3", PYTHONPATH=repo)
+               BENCH_SERVE_REQUESTS="3", BENCH_HISTORY="0", PYTHONPATH=repo)
     r = subprocess.run([sys.executable, os.path.join(repo, "bench.py")],
                        capture_output=True, text=True, env=env,
                        timeout=420, cwd=repo)

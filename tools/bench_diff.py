@@ -10,6 +10,15 @@ eyeballing two JSON files. This tool is the gate:
     python tools/bench_diff.py BENCH_r05.json BENCH_r06.json
     python tools/bench_diff.py old.json new.json --threshold 0.10
     python tools/bench_diff.py old.json new.json --json
+    python tools/bench_diff.py --history BENCH_HISTORY.jsonl
+
+`--history` (ISSUE 17 satellite) gates the standing ledger bench.py
+appends to instead of two hand-picked files: entries are grouped by
+(mode, family), and within each group the NEWEST entry is compared
+against the per-key rolling MEDIAN of all prior entries with the same
+direction-aware thresholds — the standing regression gate the BENCH_r*
+campaign runs after every round. Groups with fewer than two entries are
+skipped (nothing to compare against).
 
 It walks both `parsed` dicts (recursing into sub-dicts like
 `overload`/`normal` phases), classifies each shared numeric key by
@@ -100,18 +109,123 @@ def diff(old: Dict, new: Dict, threshold: float = 0.05) \
     return regressions, improvements, drift
 
 
+# ledger metadata stamped by bench._append_history (or non-numeric):
+# excluded from comparison so a sha change is not a "regression"
+_HISTORY_META_KEYS = {"ts", "git_sha", "mode", "family", "metric",
+                      "unit", "errors"}
+
+
+def _median(vals: List[float]) -> float:
+    vals = sorted(vals)
+    k = len(vals) // 2
+    return vals[k] if len(vals) % 2 else 0.5 * (vals[k - 1] + vals[k])
+
+
+def history_diff(entries: List[Dict], threshold: float = 0.05) \
+        -> Tuple[List[Dict], List[Tuple[str, str, int]]]:
+    """-> (regressions, groups). Newest entry per (mode, family) vs the
+    per-key median of that group's prior entries, direction-aware. Each
+    regression entry adds 'group'; `groups` lists (mode, family, n) for
+    every group seen (n < 2 means skipped)."""
+    by_group: Dict[Tuple[str, str], List[Dict]] = {}
+    for e in entries:
+        key = (str(e.get("mode", "?")), str(e.get("family", "?")))
+        by_group.setdefault(key, []).append(e)
+
+    regressions: List[Dict] = []
+    groups: List[Tuple[str, str, int]] = []
+    for (mode, family), group in sorted(by_group.items()):
+        groups.append((mode, family, len(group)))
+        if len(group) < 2:
+            continue
+        newest = _flatten({k: v for k, v in group[-1].items()
+                           if k not in _HISTORY_META_KEYS})
+        prior_flat = [_flatten({k: v for k, v in e.items()
+                                if k not in _HISTORY_META_KEYS})
+                      for e in group[:-1]]
+        for key in sorted(newest):
+            sense = direction(key)
+            if sense is None:
+                continue
+            priors = [p[key] for p in prior_flat if key in p]
+            if not priors:
+                continue
+            med = _median(priors)
+            b = newest[key]
+            if med == b:
+                continue
+            base = max(abs(med), 1e-12)
+            rel = (b - med) / base
+            gain = rel if sense == "higher" else -rel
+            if gain < -threshold:
+                regressions.append({
+                    "group": f"{mode}/{family}", "key": key,
+                    "old": med, "new": b, "direction": sense,
+                    "change": gain, "n_prior": len(priors)})
+    regressions.sort(key=lambda e: e["change"])
+    return regressions, groups
+
+
+def _main_history(args) -> int:
+    entries: List[Dict] = []
+    try:
+        with open(args.history) as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln:
+                    entries.append(json.loads(ln))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_diff: cannot read {args.history}: {e}",
+              file=sys.stderr)
+        return 2
+    regressions, groups = history_diff(entries,
+                                       threshold=args.threshold)
+    if args.json:
+        print(json.dumps({
+            "history": args.history, "threshold": args.threshold,
+            "groups": [{"mode": m, "family": f, "entries": n}
+                       for m, f, n in groups],
+            "regressions": regressions}, sort_keys=True))
+    else:
+        for e in regressions:
+            print(f"REGRESSION {e['group']} {e['key']}: "
+                  f"median {e['old']:g} -> {e['new']:g} "
+                  f"({e['change']:+.1%}, {e['direction']}-is-better, "
+                  f"n={e['n_prior']})")
+        compared = sum(1 for _, _, n in groups if n >= 2)
+        verdict = (f"{len(regressions)} regression"
+                   f"{'' if len(regressions) == 1 else 's'} beyond "
+                   f"{args.threshold:.0%}" if regressions
+                   else f"bench history ok ({compared} group"
+                        f"{'' if compared == 1 else 's'} compared)")
+        print(verdict)
+    return 1 if regressions else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="compare two BENCH_*.json files; nonzero exit on "
-                    "regression beyond --threshold")
-    ap.add_argument("old", help="baseline BENCH json")
-    ap.add_argument("new", help="candidate BENCH json")
+        description="compare two BENCH_*.json files (or gate the "
+                    "BENCH_HISTORY.jsonl ledger with --history); "
+                    "nonzero exit on regression beyond --threshold")
+    ap.add_argument("old", nargs="?", help="baseline BENCH json")
+    ap.add_argument("new", nargs="?", help="candidate BENCH json")
+    ap.add_argument("--history", default=None,
+                    help="BENCH_HISTORY.jsonl ledger: compare the newest "
+                         "entry per (mode, family) against the median of "
+                         "prior entries")
     ap.add_argument("--threshold", type=float, default=0.05,
                     help="relative regression tolerance (default 0.05 "
                          "= 5%%)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable single-line JSON output")
     args = ap.parse_args(argv)
+
+    if args.history is not None:
+        return _main_history(args)
+    if not args.old or not args.new:
+        print("bench_diff: need OLD and NEW files (or --history LEDGER)",
+              file=sys.stderr)
+        return 2
 
     payloads = []
     for path in (args.old, args.new):

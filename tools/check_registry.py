@@ -687,6 +687,82 @@ def check_metric_names():
     return problems
 
 
+def check_alert_rules():
+    """[(where, message), ...] — pin sentinel.ALERT_CATALOG against
+    telemetry.METRIC_CATALOG (ISSUE 17 satellite), the same
+    both-directions discipline as check_metric_names. A rule watching a
+    mistyped metric never raises: `Sentinel.poll` reads None forever and
+    the rule silently never fires — exactly the drift this catches. Also
+    pins the rule schema (direction/severity/reducer vocabularies,
+    positive z, non-negative cooldown), that label filters only name
+    labels the watched family actually has, and that the alert counter's
+    own catalog entry carries exactly the {rule, severity} labels
+    `Sentinel._raise` emits."""
+    from paddle_tpu import sentinel, telemetry
+
+    catalog = telemetry.METRIC_CATALOG
+    problems = []
+    for name, rule in sorted(sentinel.ALERT_CATALOG.items()):
+        where = f"sentinel.ALERT_CATALOG['{name}']"
+        entry = catalog.get(rule["metric"])
+        if entry is None:
+            problems.append((
+                where, f"watches metric '{rule['metric']}' which is not "
+                       f"in telemetry.METRIC_CATALOG — the rule can "
+                       f"never fire"))
+            continue
+        if entry["kind"] not in ("gauge", "counter"):
+            problems.append((
+                where, f"watches a {entry['kind']} family — the sentinel "
+                       f"reads gauges/counters only"))
+        lf = rule.get("label_filter") or {}
+        extra = set(lf) - set(entry["labels"])
+        if extra:
+            problems.append((
+                where, f"label filter names {sorted(extra)} but "
+                       f"'{rule['metric']}' is labeled "
+                       f"{sorted(entry['labels'])} — the filter would "
+                       f"drop every sample"))
+        if lf and not entry["labels"]:
+            problems.append((
+                where, f"label filter on unlabeled family "
+                       f"'{rule['metric']}'"))
+        if rule["direction"] not in sentinel.DIRECTIONS:
+            problems.append((
+                where, f"direction '{rule['direction']}' not in "
+                       f"{sentinel.DIRECTIONS}"))
+        if rule["severity"] not in sentinel.SEVERITIES:
+            problems.append((
+                where, f"severity '{rule['severity']}' not in "
+                       f"{sentinel.SEVERITIES}"))
+        if rule.get("reduce") not in sentinel.REDUCERS:
+            problems.append((
+                where, f"reducer '{rule.get('reduce')}' not in "
+                       f"{sentinel.REDUCERS}"))
+        if not rule["z"] > 0:
+            problems.append((where, f"z threshold {rule['z']} must be "
+                                    f"positive"))
+        if rule["cooldown_s"] < 0:
+            problems.append((where, "negative cooldown"))
+
+    # the emitter side: the ledger's counter must be cataloged with
+    # exactly the labels Sentinel._raise sets (rule, severity) — the
+    # call-site/catalog match itself is check_metric_names' job
+    alerts_entry = catalog.get("sentinel_alerts_total")
+    if alerts_entry is None:
+        problems.append((
+            "telemetry.METRIC_CATALOG",
+            "'sentinel_alerts_total' missing — sentinel alerts would "
+            "mint an uncataloged family"))
+    elif set(alerts_entry["labels"]) != {"rule", "severity"}:
+        problems.append((
+            "telemetry.METRIC_CATALOG",
+            f"'sentinel_alerts_total' labeled "
+            f"{sorted(alerts_entry['labels'])} but the sentinel emits "
+            f"{{rule, severity}}"))
+    return problems
+
+
 def main():
     problems = check_tables()
     for tname, name in problems:
@@ -718,8 +794,11 @@ def main():
     metrics = check_metric_names()
     for where, msg in metrics:
         print(f"{where}: {msg}")
+    alerts = check_alert_rules()
+    for where, msg in alerts:
+        print(f"{where}: {msg}")
     problems = problems + coll + jit + sparse + embc + pallas + inferp \
-        + servp + plroles + metrics
+        + servp + plroles + metrics + alerts
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")
