@@ -1,0 +1,1216 @@
+"""Thread-safety lint over the paddle_tpu source tree (ISSUE 18).
+
+The repo runs ~14 modules' worth of background threads (feeder window
+builders, DynamicBatcher, emb-cache prefetch, obs server, sentinel
+poll/watchdog, Go-style channels) guarded by ad-hoc locks — and PR 17
+fixed a builder/consumer data race that was found by accident, not by
+tooling. This module is the static leg of the correctness tooling
+(after PR 12's program verifier and PR 17's runtime sentinel): a
+ThreadSanitizer-flavoured *lockset* analysis specialized to this
+codebase's concurrency idioms, pure AST, no imports of the linted
+modules, safe to run in CI.
+
+What it computes, per module of ``paddle_tpu/``:
+
+  * a **thread census**: every ``threading.Thread(...)`` and ``go(...)``
+    creation site, its static name (or f-string prefix), daemon flag,
+    target, where the handle is stored and whether it is ever joined,
+    plus the self-attributes/globals the target's body reaches. The
+    census is pinned against the declared `THREAD_CATALOG` in both
+    directions (``tools/check_registry.py check_thread_catalog``).
+  * a **lockset model**: which lock guards each shared field, inferred
+    from ``with self._lock:`` / ``with _LOCK:`` scopes (including the
+    repo's ``*_locked``-suffix convention for methods that run with the
+    class lock already held).
+  * a **lock-order graph** across modules via a call-graph fixpoint of
+    "locks this function may acquire" (depth-unbounded within the
+    resolvable call graph: self-methods, same-module functions and
+    closures, uniquely-named same-module methods, and
+    ``mod.fn(...)`` calls into other paddle_tpu modules).
+
+Diagnostics (PR 12 vocabulary — `analysis.Diagnostic` with stable codes,
+``file:line`` sites and fix-it hints):
+
+  lockset-mixed-guard   (error)   field guarded by a lock in one method
+                                  but accessed bare in another
+  lock-order-cycle      (error)   cycle in the lock-order graph
+                                  (deadlock potential)
+  blocking-under-lock   (error)   blocking call (``.join()``,
+                                  ``time.sleep``, ``open()``, HTTP,
+                                  unbounded ``queue.get``/``.wait()``/
+                                  ``.result()``, ``np.asarray``/
+                                  ``jax.device_put`` device syncs) while
+                                  holding a lock
+  thread-unnamed        (error)   Thread(...) without ``name=`` — hang
+                                  reports and the census need identities
+  thread-non-daemon     (warning) background thread that can wedge
+                                  interpreter shutdown
+  thread-never-joined   (warning) catalog says joined=True but no join
+                                  site exists in the module
+  thread-uncataloged    (error)   creation site missing from
+                                  THREAD_CATALOG
+  thread-catalog-stale  (error)   THREAD_CATALOG entry with no matching
+                                  creation site
+  thread-census         (info)    one advisory line per creation site
+
+Intentional violations are waived in place with a trailing comment
+``# thread-lint: ok <code>[, <code>...]`` on the flagged line — the
+waiver is part of the diff, reviewable, and scoped to one line+code.
+
+Entry points: ``analyze_threads()`` -> `analysis.Report`,
+``python -m paddle_tpu analyze --threads`` (cli.py), and
+``catalog_problems()`` consumed by ``tools/check_registry.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from . import Diagnostic, Report
+
+__all__ = ["THREAD_CATALOG", "ThreadSite", "analyze_threads",
+           "thread_census", "catalog_problems"]
+
+PASS_NAME = "threads"
+
+# --- the declared thread catalog ---------------------------------------------
+# Every Thread/go creation site in paddle_tpu/ must map to exactly one
+# entry here (by module + static name/prefix), and every entry must have
+# at least one site — check_registry.py pins both directions. `joined`
+# declares whether the OWNING module joins the thread ("detached" threads
+# hand the handle to their caller or run for the process lifetime).
+
+THREAD_CATALOG: Dict[str, Dict[str, Any]] = {
+    "pd-feeder-batch": dict(
+        module="paddle_tpu/reader/pipeline.py", daemon=True, joined=True,
+        help="per-batch producer: converts + device_puts batches into "
+             "the bounded double-buffer queue"),
+    "pd-feeder-window": dict(
+        module="paddle_tpu/reader/pipeline.py", daemon=True, joined=True,
+        help="window builder: stacks k batches + device_puts whole "
+             "windows ahead of the fused multi-step loop"),
+    "pd-reader-buffered": dict(
+        module="paddle_tpu/reader/__init__.py", daemon=True, joined=False,
+        help="buffered() fill thread; ends with the pass, surfaced "
+             "errors ride the queue"),
+    "pd-emb-prefetch": dict(
+        module="paddle_tpu/parallel/emb_cache.py", daemon=True,
+        joined=True,
+        help="one background hot-row prefetch; joined by "
+             "_PrefetchHandle.wait()"),
+    "pd-go-": dict(
+        module="paddle_tpu/concurrency.py", prefix=True, daemon=True,
+        joined=False,
+        help="go()-launched goroutine; the handle is returned for the "
+             "caller to join"),
+    "pd-serving-client-": dict(
+        module="paddle_tpu/serving/harness.py", prefix=True, daemon=True,
+        joined=True,
+        help="load-harness client threads, joined under the sentinel "
+             "dispatch watchdog"),
+    "serving-batcher": dict(
+        module="paddle_tpu/serving/batcher.py", daemon=True, joined=True,
+        help="DynamicBatcher worker: collects + executes batches; "
+             "joined by close()"),
+    "paddle-tpu-obs": dict(
+        module="paddle_tpu/obs_server.py", daemon=True, joined=True,
+        help="observability HTTP server loop; joined by stop()"),
+    "paddle-tpu-sentinel-poll": dict(
+        module="paddle_tpu/sentinel.py", daemon=True, joined=True,
+        help="sentinel metric poll loop; joined by Sentinel.stop()"),
+    "paddle-tpu-sentinel-watch": dict(
+        module="paddle_tpu/sentinel.py", daemon=True, joined=True,
+        help="sentinel hang watchdog loop; joined by Sentinel.stop()"),
+    "sentinel-stall-drill": dict(
+        module="paddle_tpu/sentinel.py", daemon=True, joined=False,
+        help="inject_stall() drill dispatch; handle returned for the "
+             "caller (cli --smoke) to join"),
+    "paddle_tpu_pool_": dict(
+        module="paddle_tpu/threadpool.py", prefix=True, daemon=True,
+        joined=False,
+        help="ThreadPool workers; daemon lifetime, shutdown drains via "
+             "sentinel tasks"),
+    "ilv-": dict(
+        module="paddle_tpu/testing/interleave.py", prefix=True,
+        daemon=True, joined=True,
+        help="interleave-harness worker threads, scheduled "
+             "cooperatively under a seeded schedule"),
+}
+
+# --- classification tables ---------------------------------------------------
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+# internally-synchronized containers: exempt from mixed-guard (their
+# methods are atomic; a lock around them is belt-and-braces, not a
+# guard discipline)
+_SYNC_FACTORIES = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                   "Event", "local"}
+_LOCKISH_ATTRS = {"_lock", "_cond", "_LOCK", "_mu", "_mutex"}
+# methods that mutate their receiver — an `self.x.append(...)` is a
+# write to x for lockset purposes
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
+             "remove", "clear", "update", "add", "discard", "setdefault",
+             "popitem"}
+# calls that block (or synchronize with the device) — flagged when made
+# while holding a lock. `transitive=False` ops are only flagged when
+# they appear directly in the locked body: np.asarray on a small host
+# array is instant, so propagating it through the call graph would
+# drown the signal; a *direct* device sync inside a critical section is
+# the reviewable pattern.
+_BLOCKING_NAME_CALLS = {
+    # dotted-name call -> (description, transitive)
+    "time.sleep": ("time.sleep()", True),
+    "np.asarray": ("np.asarray() device sync", False),
+    "numpy.asarray": ("np.asarray() device sync", False),
+    "jax.device_put": ("jax.device_put()", False),
+    "urllib.request.urlopen": ("urlopen()", True),
+    "urlopen": ("urlopen()", True),
+    "requests.get": ("HTTP request", True),
+    "requests.post": ("HTTP request", True),
+    "open": ("file open()", True),
+}
+# method calls (by attribute name, 0 positional args) that block unless
+# bounded by a timeout= keyword
+_BLOCKING_METHODS_TIMEOUT_OK = {
+    "get": "unbounded queue.get()",
+    "wait": "unbounded .wait()",
+    "result": "future .result()",
+}
+# method calls that block regardless of timeout (joining a thread that
+# may itself need the held lock is a deadlock in one hop; a bounded
+# join still parks the lock for the full timeout)
+_BLOCKING_METHODS_ALWAYS = {
+    "join": ".join() on a thread",
+    "shutdown": ".shutdown()",
+    "serve_forever": ".serve_forever()",
+    "block_until_ready": ".block_until_ready() device sync",
+}
+
+_WAIVER_RE = re.compile(r"#\s*thread-lint:\s*ok\s+([a-z\-,\s]+)")
+
+
+# --- data model --------------------------------------------------------------
+
+@dataclass
+class ThreadSite:
+    """One Thread(...)/go(...) creation site discovered in the census."""
+
+    module: str                       # repo-relative path
+    lineno: int
+    kind: str                         # "thread" | "go"
+    name: Optional[str] = None        # static name or f-string prefix
+    name_is_prefix: bool = False
+    daemon: Optional[bool] = None
+    target: Optional[str] = None
+    stored_in: Optional[str] = None   # receiver the handle lands in
+    joined: bool = False
+    reaches: Tuple[str, ...] = ()     # attrs/globals the target touches
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items() if v not in
+                (None, (), False)}
+
+
+@dataclass
+class _Fn:
+    qualname: str
+    name: str
+    klass: Optional[str]
+    lineno: int
+    locals_: Set[str] = field(default_factory=set)
+    globals_decl: Set[str] = field(default_factory=set)
+    local_locks: Set[str] = field(default_factory=set)
+    # (scope "attr"|"global", name, is_write, held lock keys, lineno)
+    accesses: List[Tuple[str, str, bool, Tuple[str, ...], int]] = \
+        field(default_factory=list)
+    acquires: List[Tuple[str, int]] = field(default_factory=list)
+    # (outer lock, inner lock, lineno of inner acquire)
+    nested: List[Tuple[str, str, int]] = field(default_factory=list)
+    # (held lock keys, callee descriptor, lineno)
+    calls: List[Tuple[Tuple[str, ...], Tuple, int]] = \
+        field(default_factory=list)
+    # (held lock keys, description, lineno, transitive?)
+    blocking: List[Tuple[Tuple[str, ...], str, int, bool]] = \
+        field(default_factory=list)
+    # class-own condition locks this function wait()s/notify()s on —
+    # Python requires the caller to hold a Condition to wait on it, so
+    # the whole body implicitly runs with these held
+    waits_on: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class _Class:
+    name: str
+    lock_attrs: Set[str] = field(default_factory=set)
+    sync_attrs: Set[str] = field(default_factory=set)
+    method_names: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class _Module:
+    relpath: str
+    modname: str
+    functions: Dict[str, _Fn] = field(default_factory=dict)
+    classes: Dict[str, _Class] = field(default_factory=dict)
+    global_locks: Set[str] = field(default_factory=set)
+    global_names: Set[str] = field(default_factory=set)
+    imports: Dict[str, str] = field(default_factory=dict)
+    thread_sites: List[ThreadSite] = field(default_factory=list)
+    join_receivers: Set[str] = field(default_factory=set)
+    # loop alias -> iterated name (for `for t in threads: t.join()`)
+    for_aliases: Dict[str, str] = field(default_factory=dict)
+    # local name -> collection it is append()ed into (resolves
+    # `threads.append(t)` ... `for t in threads: t.join()` chains)
+    append_into: Dict[str, str] = field(default_factory=dict)
+    waivers: Dict[int, Set[str]] = field(default_factory=dict)
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 - display only
+        return "<expr>"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> "a.b.c", Name -> "a"; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_factory(node: ast.AST, factories: Set[str]) -> bool:
+    """True when `node` is a call of threading.X()/queue.X()/deque()...
+    whose terminal name is in `factories`."""
+    if not isinstance(node, ast.Call):
+        return False
+    dn = _dotted(node.func)
+    if dn is None:
+        return False
+    return dn.split(".")[-1] in factories
+
+
+def _static_name(expr: ast.AST) -> Tuple[Optional[str], bool]:
+    """Extract a Thread name= value: (literal, False) for a constant,
+    (leading static prefix, True) for an f-string, (None, False)
+    otherwise."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value, False
+    if isinstance(expr, ast.JoinedStr):
+        prefix = ""
+        for part in expr.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value,
+                                                             str):
+                prefix += part.value
+            else:
+                break
+        return (prefix or None), True
+    if isinstance(expr, ast.BoolOp) and isinstance(expr.op, ast.Or) \
+            and expr.values:
+        # `name=name or f"pd-go-{...}"` — the fallback is the statically
+        # known floor; a caller override only makes it MORE specific
+        sub, _ = _static_name(expr.values[-1])
+        if sub is not None:
+            return sub, True
+    return None, False
+
+
+# --- per-function walker -----------------------------------------------------
+
+class _FnWalker(ast.NodeVisitor):
+    """Walk one function body tracking the held-lock stack, recording
+    field/global accesses, lock acquisitions, nesting pairs, call sites
+    and direct blocking calls."""
+
+    def __init__(self, mod: _Module, fn: _Fn, models: "Dict[str, _Module]"):
+        self.mod = mod
+        self.fn = fn
+        self.models = models
+        self.held: List[str] = []
+
+    # -- lock resolution --
+    def _resolve_lock(self, expr: ast.AST) -> Optional[str]:
+        dn = _dotted(expr)
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        if len(parts) == 1:
+            nm = parts[0]
+            if nm in self.mod.global_locks:
+                return f"{self.mod.relpath}:{nm}"
+            if nm in self.fn.local_locks:
+                return f"{self.mod.relpath}:{self.fn.qualname}.{nm}"
+            return None
+        if parts[0] == "self" and self.fn.klass:
+            kl = self.mod.classes.get(self.fn.klass)
+            if len(parts) == 2 and kl and parts[1] in kl.lock_attrs:
+                return f"{self.mod.relpath}:{self.fn.klass}.{parts[1]}"
+            # self.a.b..._lock: opaque foreign lock reached through an
+            # attribute chain — keyed by the chain so nesting is still
+            # visible, without claiming an identity we can't prove
+            if parts[-1] in _LOCKISH_ATTRS:
+                return f"{self.mod.relpath}:{self.fn.klass}" \
+                       f".<{'.'.join(parts[1:])}>"
+            return None
+        if parts[0] in self.mod.imports and len(parts) == 2:
+            other = self.models.get(self.mod.imports[parts[0]])
+            if other and parts[1] in other.global_locks:
+                return f"{other.relpath}:{parts[1]}"
+        if parts[-1] in _LOCKISH_ATTRS:
+            return f"{self.mod.relpath}:<{dn}>"
+        return None
+
+    # -- structure --
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        pass            # nested defs are walked as their own _Fn
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        pass
+
+    def visit_With(self, node: ast.With):
+        locks: List[str] = []
+        for item in node.items:
+            key = self._resolve_lock(item.context_expr)
+            if key is not None:
+                self.fn.acquires.append((key, item.context_expr.lineno))
+                for outer in self.held:
+                    if outer != key:
+                        self.fn.nested.append(
+                            (outer, key, item.context_expr.lineno))
+                locks.append(key)
+            else:
+                self.visit(item.context_expr)
+        self.held.extend(locks)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(locks):]
+
+    # -- accesses --
+    def _record(self, scope: str, name: str, write: bool, lineno: int):
+        self.fn.accesses.append(
+            (scope, name, write, tuple(self.held), lineno))
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "self" \
+                and self.fn.klass:
+            self._record("attr", node.attr,
+                         isinstance(node.ctx, (ast.Store, ast.Del)),
+                         node.lineno)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        nm = node.id
+        if nm in self.mod.global_names and nm not in self.fn.locals_:
+            write = isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and nm in self.fn.globals_decl
+            if write or isinstance(node.ctx, ast.Load):
+                self._record("global", nm, write, node.lineno)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        # self.x[k] = v / _g[0] += 1: a write to the container
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            base = node.value
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and self.fn.klass:
+                self._record("attr", base.attr, True, node.lineno)
+            elif isinstance(base, ast.Name) and \
+                    base.id in self.mod.global_names and \
+                    base.id not in self.fn.locals_:
+                self._record("global", base.id, True, node.lineno)
+        self.generic_visit(node)
+
+    # -- calls --
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        dn = _dotted(func)
+        held = tuple(self.held)
+        npos = len(node.args)
+        kwnames = {kw.arg for kw in node.keywords}
+
+        # mutator method on a tracked receiver is a write
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            base = func.value
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and self.fn.klass:
+                self._record("attr", base.attr, True, node.lineno)
+            elif isinstance(base, ast.Name) and \
+                    base.id in self.mod.global_names and \
+                    base.id not in self.fn.locals_:
+                self._record("global", base.id, True, node.lineno)
+
+        # wait()/notify() on a class-own condition: the caller must
+        # already hold it (Condition semantics), and wait() RELEASES it
+        # — record the implied-held lock, never a blocking hazard
+        own_cond = None
+        if isinstance(func, ast.Attribute) and \
+                func.attr in ("wait", "notify", "notify_all"):
+            rk = self._resolve_lock(func.value)
+            if rk is not None and self.fn.klass and \
+                    rk.startswith(f"{self.mod.relpath}:{self.fn.klass}."):
+                self.fn.waits_on.add(rk)
+                own_cond = rk
+
+        # direct blocking calls
+        desc = None
+        if dn is not None and dn in _BLOCKING_NAME_CALLS:
+            what, transitive = _BLOCKING_NAME_CALLS[dn]
+            desc = (what, transitive)
+        elif isinstance(func, ast.Attribute) and npos == 0:
+            attr = func.attr
+            if attr in _BLOCKING_METHODS_ALWAYS:
+                desc = (_BLOCKING_METHODS_ALWAYS[attr], True)
+            elif attr in _BLOCKING_METHODS_TIMEOUT_OK \
+                    and "timeout" not in kwnames:
+                # `self._cond.wait()` releases the condition it waits
+                # on — not a blocking hazard for that lock itself
+                if not (attr == "wait" and
+                        (own_cond is not None or
+                         self._resolve_lock(func.value) in self.held)):
+                    desc = (_BLOCKING_METHODS_TIMEOUT_OK[attr], True)
+        if desc is not None:
+            self.fn.blocking.append(
+                (held, desc[0], node.lineno, desc[1]))
+
+        # call-graph edge for the interprocedural fixpoints
+        callee = None
+        if isinstance(func, ast.Name):
+            callee = ("name", func.id)
+        elif isinstance(func, ast.Attribute):
+            base = _dotted(func.value)
+            if base == "self":
+                callee = ("self", func.attr)
+            elif base in self.mod.imports:
+                callee = ("mod", self.mod.imports[base], func.attr)
+            else:
+                callee = ("method", func.attr)
+        if callee is not None:
+            self.fn.calls.append((held, callee, node.lineno))
+        self.generic_visit(node)
+
+
+# --- module model builder ----------------------------------------------------
+
+def _collect_locals(fn_node) -> Tuple[Set[str], Set[str]]:
+    """(assigned-or-bound names, declared globals) of one function,
+    nested defs excluded."""
+    locals_: Set[str] = set()
+    globals_decl: Set[str] = set()
+    for a in list(fn_node.args.args) + list(fn_node.args.kwonlyargs) \
+            + list(fn_node.args.posonlyargs):
+        locals_.add(a.arg)
+    if fn_node.args.vararg:
+        locals_.add(fn_node.args.vararg.arg)
+    if fn_node.args.kwarg:
+        locals_.add(fn_node.args.kwarg.arg)
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                if hasattr(child, "name"):
+                    locals_.add(child.name)
+                continue
+            if isinstance(child, ast.Global):
+                globals_decl.update(child.names)
+            elif isinstance(child, ast.Name) and \
+                    isinstance(child.ctx, ast.Store):
+                locals_.add(child.id)
+            walk(child)
+
+    walk(fn_node)
+    locals_ -= globals_decl
+    return locals_, globals_decl
+
+
+class _ModuleBuilder:
+    def __init__(self, relpath: str, modname: str, source: str):
+        self.mod = _Module(relpath=relpath, modname=modname)
+        self.is_pkg = relpath.endswith("__init__.py")
+        self.source = source
+        self.tree = ast.parse(source)
+        for i, line in enumerate(source.splitlines(), 1):
+            m = _WAIVER_RE.search(line)
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",")
+                         if c.strip()}
+                self.mod.waivers.setdefault(i, set()).update(codes)
+
+    # pass 1: module-level names, imports, classes + lock/sync attrs
+    def scan_toplevel(self, package: str):
+        mod = self.mod
+        for node in self.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._scan_import(node, package)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                value = node.value
+                for t in targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if value is not None and \
+                            _call_factory(value, _LOCK_FACTORIES):
+                        mod.global_locks.add(t.id)
+                    else:
+                        mod.global_names.add(t.id)
+            elif isinstance(node, ast.ClassDef):
+                kl = _Class(name=node.name)
+                mod.classes[node.name] = kl
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        kl.method_names.add(item.name)
+                        for sub in ast.walk(item):
+                            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                                self._scan_attr_types(kl, sub)
+
+    def _scan_import(self, node, package: str):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith(package + "."):
+                    self.mod.imports[alias.asname or
+                                     alias.name.split(".")[-1]] = alias.name
+            return
+        # from . import telemetry / from ..parallel import emb_cache /
+        # from paddle_tpu import x
+        base: Optional[str] = None
+        if node.level:
+            parts = self.mod.modname.split(".")
+            # a module's level-1 base is its package; a package
+            # __init__'s level-1 base is itself
+            drop = node.level - (1 if self.is_pkg else 0)
+            keep = len(parts) - drop
+            if keep >= 1:
+                base = ".".join(parts[:keep])
+                if node.module:
+                    base = f"{base}.{node.module}"
+        elif node.module and (node.module == package or
+                              node.module.startswith(package + ".")):
+            base = node.module
+        if base is None or not base.startswith(package):
+            return
+        for alias in node.names:
+            self.mod.imports[alias.asname or alias.name] = \
+                f"{base}.{alias.name}"
+
+    @staticmethod
+    def _scan_attr_types(kl: _Class, node):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        value = node.value
+        if value is None:
+            return
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                if _call_factory(value, _LOCK_FACTORIES):
+                    kl.lock_attrs.add(t.attr)
+                elif _call_factory(value, _SYNC_FACTORIES) or \
+                        _call_factory(value, {"deque"}):
+                    kl.sync_attrs.add(t.attr)
+
+    # pass 2: register every function/method/closure
+    def register_functions(self):
+        def reg(node, qualprefix: str, klass: Optional[str]):
+            for child in node.body if hasattr(node, "body") else []:
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    q = f"{qualprefix}{child.name}"
+                    locals_, gdecl = _collect_locals(child)
+                    fn = _Fn(qualname=q, name=child.name, klass=klass,
+                             lineno=child.lineno, locals_=locals_,
+                             globals_decl=gdecl)
+                    for sub in ast.walk(child):
+                        if isinstance(sub, ast.Assign) and \
+                                _call_factory(sub.value, _LOCK_FACTORIES):
+                            for t in sub.targets:
+                                if isinstance(t, ast.Name):
+                                    fn.local_locks.add(t.id)
+                    self.mod.functions[q] = fn
+                    fn._node = child          # type: ignore[attr-defined]
+                    reg(child, q + ".", klass)
+                elif isinstance(child, ast.ClassDef):
+                    reg(child, f"{child.name}.", child.name)
+
+        reg(self.tree, "", None)
+
+    # pass 3: walk each function with the lockset walker, then census
+    def walk(self, models: Dict[str, _Module]):
+        for fn in self.mod.functions.values():
+            node = fn._node                   # type: ignore[attr-defined]
+            walker = _FnWalker(self.mod, fn, models)
+            for stmt in node.body:
+                walker.visit(stmt)
+        self._census()
+
+    # -- thread census --
+    def _census(self):
+        mod = self.mod
+        alias_elems: Dict[str, Set[str]] = {}
+        # join receivers + for-aliases (to resolve `for t in threads`)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join" and not node.args:
+                # 0 positional args excludes str.join(iterable)
+                recv = _dotted(node.func.value)
+                if recv:
+                    mod.join_receivers.add(recv)
+            if isinstance(node, ast.For) and \
+                    isinstance(node.target, ast.Name):
+                it = _dotted(node.iter)
+                if it:
+                    mod.for_aliases[node.target.id] = it.split(".")[-1]
+                elif isinstance(node.iter, (ast.Tuple, ast.List)):
+                    # `for t in (poll_t, watch_t):` — the alias covers
+                    # every literal element
+                    for el in node.iter.elts:
+                        en = _dotted(el)
+                        if en:
+                            alias_elems.setdefault(
+                                node.target.id, set()).add(
+                                    en.split(".")[-1])
+        # second walk: `threads.append(t)` edges — `t` may itself be a
+        # literal-tuple alias collected above, in which case every
+        # element it covers lands in the collection
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "append" and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name):
+                recv = _dotted(node.func.value)
+                if recv:
+                    arg = node.args[0].id
+                    for nm in alias_elems.get(arg, {arg}):
+                        mod.append_into[nm] = recv.split(".")[-1]
+
+        class _SiteFinder(ast.NodeVisitor):
+            def __init__(self, builder):
+                self.b = builder
+                self.assign_stack: List[ast.AST] = []
+
+            def visit_Assign(self, node):
+                self.assign_stack.append(node)
+                self.generic_visit(node)
+                self.assign_stack.pop()
+
+            def visit_Call(self, node):
+                dn = _dotted(node.func)
+                last = dn.split(".")[-1] if dn else None
+                if last == "Thread" and dn in ("threading.Thread",
+                                               "Thread"):
+                    self.b._thread_site(node, self.assign_stack)
+                elif last in ("go", "Go") and \
+                        self.b.mod.modname != "paddle_tpu.concurrency":
+                    self.b._go_site(node)
+                self.generic_visit(node)
+
+        _SiteFinder(self).visit(self.tree)
+
+    def _thread_site(self, node: ast.Call, assign_stack: List[ast.AST]):
+        kwargs = {kw.arg: kw.value for kw in node.keywords}
+        name, is_prefix = (None, False)
+        if "name" in kwargs:
+            name, is_prefix = _static_name(kwargs["name"])
+        daemon: Optional[bool] = None
+        if "daemon" in kwargs and isinstance(kwargs["daemon"],
+                                             ast.Constant):
+            daemon = bool(kwargs["daemon"].value)
+        target = _dotted(kwargs["target"]) if "target" in kwargs else None
+        stored = None
+        for a in reversed(assign_stack):
+            if isinstance(a, ast.Assign) and a.targets:
+                stored = _dotted(a.targets[0])
+                break
+        base = stored.split(".")[-1] if stored else None
+        joined = self._is_joined(base)
+        self.mod.thread_sites.append(ThreadSite(
+            module=self.mod.relpath, lineno=node.lineno, kind="thread",
+            name=name, name_is_prefix=is_prefix, daemon=daemon,
+            target=target, stored_in=stored, joined=joined,
+            reaches=self._reaches(target)))
+
+    def _go_site(self, node: ast.Call):
+        target = _dotted(node.args[0]) if node.args else None
+        self.mod.thread_sites.append(ThreadSite(
+            module=self.mod.relpath, lineno=node.lineno, kind="go",
+            name="pd-go-", name_is_prefix=True, daemon=True,
+            target=target, joined=False, reaches=self._reaches(target)))
+
+    def _is_joined(self, base: Optional[str]) -> bool:
+        if base is None:
+            return False
+        targets = {base}
+        coll = self.mod.append_into.get(base)
+        if coll:
+            targets.add(coll)     # joined via the collection it lives in
+        for recv in self.mod.join_receivers:
+            rb = recv.split(".")[-1]
+            if rb in targets:
+                return True
+            if self.mod.for_aliases.get(rb) in targets:
+                return True
+        return False
+
+    def _reaches(self, target: Optional[str]) -> Tuple[str, ...]:
+        """attrs/globals the thread target's body touches (depth 1)."""
+        if target is None:
+            return ()
+        base = target.split(".")[-1]
+        for q, fn in self.mod.functions.items():
+            if q == base or q.endswith("." + base):
+                names = sorted({("self." + n if sc == "attr" else n)
+                                for sc, n, _w, _h, _l in fn.accesses})
+                return tuple(names[:12])
+        return ()
+
+
+# --- model construction ------------------------------------------------------
+
+def _package_root() -> Tuple[str, str]:
+    """(repo root dir, package dir name)."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg_dir), os.path.basename(pkg_dir)
+
+
+def build_models(root: Optional[str] = None) -> Dict[str, _Module]:
+    """Parse every .py under the paddle_tpu package into module models,
+    keyed by dotted module name."""
+    repo, package = _package_root()
+    if root is None:
+        root = os.path.join(repo, package)
+    base = os.path.dirname(os.path.abspath(root))
+    builders: List[_ModuleBuilder] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in sorted(filenames):
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, f)
+            rel = os.path.relpath(path, base).replace(os.sep, "/")
+            modname = rel[:-3].replace("/", ".")
+            if modname.endswith(".__init__"):
+                modname = modname[:-len(".__init__")]
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    src = fh.read()
+                builders.append(_ModuleBuilder(rel, modname, src))
+            except (OSError, SyntaxError):
+                continue
+    models: Dict[str, _Module] = {}
+    pkg = os.path.basename(os.path.abspath(root))
+    for b in builders:
+        b.scan_toplevel(pkg)
+        b.register_functions()
+        models[b.mod.modname] = b.mod
+    for b in builders:
+        b.walk(models)
+    return models
+
+
+def thread_census(root: Optional[str] = None,
+                  models: Optional[Dict[str, _Module]] = None
+                  ) -> List[ThreadSite]:
+    models = models if models is not None else build_models(root)
+    sites: List[ThreadSite] = []
+    for mod in models.values():
+        sites.extend(mod.thread_sites)
+    return sorted(sites, key=lambda s: (s.module, s.lineno))
+
+
+# --- interprocedural fixpoints -----------------------------------------------
+
+def _resolve_callee(mod: _Module, fn: _Fn, callee: Tuple,
+                    models: Dict[str, _Module]) -> List[Tuple[_Module, _Fn]]:
+    kind = callee[0]
+    out: List[Tuple[_Module, _Fn]] = []
+    if kind == "name":
+        nm = callee[1]
+        # closure sibling/child first, then module function
+        pref = fn.qualname + "."
+        cand = mod.functions.get(pref + nm)
+        if cand is None and "." in fn.qualname:
+            parent = fn.qualname.rsplit(".", 1)[0]
+            cand = mod.functions.get(parent + "." + nm)
+        if cand is None:
+            cand = mod.functions.get(nm)
+        if cand is not None:
+            out.append((mod, cand))
+    elif kind == "self":
+        if fn.klass:
+            cand = mod.functions.get(f"{fn.klass}.{callee[1]}")
+            if cand is not None:
+                out.append((mod, cand))
+    elif kind == "method":
+        for kl in mod.classes.values():
+            if callee[1] in kl.method_names:
+                cand = mod.functions.get(f"{kl.name}.{callee[1]}")
+                if cand is not None:
+                    out.append((mod, cand))
+    elif kind == "mod":
+        other = models.get(callee[1])
+        if other is not None:
+            cand = other.functions.get(callee[2])
+            if cand is not None and cand.klass is None:
+                out.append((other, cand))
+    return out
+
+
+def _fixpoints(models: Dict[str, _Module]):
+    """Per-function transitive acquired-lock sets and may-block reasons.
+    Returns ({qual: set(lockkeys)}, {qual: (description, via)})
+    keyed by "relpath:qualname"."""
+    acq: Dict[str, Set[str]] = {}
+    blk: Dict[str, Tuple[str, str]] = {}
+    key = lambda m, f: f"{m.relpath}:{f.qualname}"  # noqa: E731
+    for mod in models.values():
+        for fn in mod.functions.values():
+            acq[key(mod, fn)] = {k for k, _l in fn.acquires}
+            for _held, what, _line, transitive in fn.blocking:
+                if transitive and key(mod, fn) not in blk:
+                    blk[key(mod, fn)] = (what, fn.qualname)
+    for _ in range(6):             # call chains in this repo are shallow
+        changed = False
+        for mod in models.values():
+            for fn in mod.functions.values():
+                k = key(mod, fn)
+                for _held, callee, _line in fn.calls:
+                    for om, ofn in _resolve_callee(mod, fn, callee,
+                                                   models):
+                        ok = key(om, ofn)
+                        extra = acq.get(ok, set()) - acq[k]
+                        if extra:
+                            acq[k] |= extra
+                            changed = True
+                        if ok in blk and k not in blk:
+                            blk[k] = (blk[ok][0],
+                                      f"{ofn.qualname} "
+                                      f"({om.relpath})")
+                            changed = True
+        if not changed:
+            break
+    return acq, blk
+
+
+# --- rules -------------------------------------------------------------------
+
+def _waived(mod: _Module, lineno: int, code: str) -> bool:
+    return code in mod.waivers.get(lineno, ())
+
+
+def _emit(diags: List[Diagnostic], mod: _Module, lineno: int,
+          severity: str, code: str, message: str,
+          hint: Optional[str] = None, var: Optional[str] = None):
+    if _waived(mod, lineno, code):
+        return
+    diags.append(Diagnostic(
+        severity=severity, code=code, message=message,
+        pass_name=PASS_NAME, var=var,
+        site=f"{mod.relpath}:{lineno}", hint=hint))
+
+
+def _module_lock_keys(mod: _Module) -> Set[str]:
+    keys = {f"{mod.relpath}:{g}" for g in mod.global_locks}
+    for kl in mod.classes.values():
+        keys |= {f"{mod.relpath}:{kl.name}.{a}" for a in kl.lock_attrs}
+    return keys
+
+
+def _primary_lock(mod: _Module, klass: str) -> Optional[str]:
+    kl = mod.classes.get(klass)
+    if kl and len(kl.lock_attrs) == 1:
+        return f"{mod.relpath}:{klass}.{next(iter(kl.lock_attrs))}"
+    return None
+
+
+def _rule_mixed_guard(models: Dict[str, _Module],
+                      diags: List[Diagnostic]):
+    for mod in models.values():
+        own = _module_lock_keys(mod)
+        # class fields
+        by_field: Dict[Tuple[str, str],
+                       List[Tuple[bool, Tuple[str, ...], int, str]]] = {}
+        for fn in mod.functions.values():
+            if fn.klass is None or fn.name == "__init__":
+                continue
+            implied = tuple(sorted(fn.waits_on))
+            if fn.name.endswith("_locked"):
+                pl = _primary_lock(mod, fn.klass)
+                if pl:
+                    implied = implied + (pl,)
+            for scope, name, write, held, lineno in fn.accesses:
+                if scope != "attr":
+                    continue
+                kl = mod.classes.get(fn.klass)
+                if kl is None or name in kl.lock_attrs or \
+                        name in kl.sync_attrs or name in kl.method_names:
+                    continue
+                h = tuple(held) + implied
+                by_field.setdefault((fn.klass, name), []).append(
+                    (write, h, lineno, fn.qualname))
+        for (klass, name), accs in sorted(by_field.items()):
+            _judge_field(mod, own, f"{klass}.{name}", name, accs, diags)
+        # module globals
+        by_glob: Dict[str, List[Tuple[bool, Tuple[str, ...], int, str]]] = {}
+        for fn in mod.functions.values():
+            for scope, name, write, held, lineno in fn.accesses:
+                if scope == "global":
+                    by_glob.setdefault(name, []).append(
+                        (write, tuple(held), lineno, fn.qualname))
+        for name, accs in sorted(by_glob.items()):
+            _judge_field(mod, own, name, name, accs, diags)
+
+
+def _judge_field(mod: _Module, own_locks: Set[str], label: str,
+                 var: str, accs, diags: List[Diagnostic]):
+    guarded = [(w, h, l, q) for w, h, l, q in accs
+               if any(k in own_locks for k in h)]
+    bare = [(w, h, l, q) for w, h, l, q in accs
+            if not any(k in own_locks for k in h)]
+    writes = [a for a in accs if a[0]]
+    if not guarded or not bare or not writes:
+        return
+    locks = sorted({k for _w, h, _l, _q in guarded for k in h
+                    if k in own_locks})
+    lock_names = ", ".join(k.split(":", 1)[1] for k in locks)
+    for _w, _h, lineno, qual in sorted(bare, key=lambda a: a[2]):
+        _emit(diags, mod, lineno, "error", "lockset-mixed-guard",
+              f"'{label}' is guarded by {lock_names} elsewhere in this "
+              f"module but accessed bare in {qual}()",
+              hint=f"hold {lock_names} here too, or waive with "
+                   f"'# thread-lint: ok lockset-mixed-guard' if this "
+                   f"access provably happens-before/after all "
+                   f"concurrent use", var=var)
+
+
+def _rule_lock_order(models: Dict[str, _Module], acq: Dict[str, Set[str]],
+                     diags: List[Diagnostic]):
+    edges: Dict[Tuple[str, str], Tuple[_Module, int]] = {}
+
+    def add(outer, inner, mod, lineno):
+        if outer != inner:
+            edges.setdefault((outer, inner), (mod, lineno))
+
+    for mod in models.values():
+        for fn in mod.functions.values():
+            for outer, inner, lineno in fn.nested:
+                add(outer, inner, mod, lineno)
+            for held, callee, lineno in fn.calls:
+                if not held:
+                    continue
+                for om, ofn in _resolve_callee(mod, fn, callee, models):
+                    for inner in acq.get(f"{om.relpath}:{ofn.qualname}",
+                                         ()):
+                        for outer in held:
+                            add(outer, inner, mod, lineno)
+    # DFS cycle detection over the lock graph
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+    seen: Set[str] = set()
+    reported: Set[frozenset] = set()
+
+    def dfs(node, stack, onstack):
+        seen.add(node)
+        onstack.add(node)
+        stack.append(node)
+        for nxt in graph.get(node, ()):
+            if nxt in onstack:
+                cycle = stack[stack.index(nxt):] + [nxt]
+                fs = frozenset(cycle)
+                if fs not in reported:
+                    reported.add(fs)
+                    mod, lineno = edges[(node, nxt)]
+                    _emit(diags, mod, lineno, "error",
+                          "lock-order-cycle",
+                          "lock-order cycle (deadlock potential): "
+                          + " -> ".join(c.split(":", 1)[1]
+                                        for c in cycle),
+                          hint="acquire these locks in one global "
+                               "order everywhere, or drop to a "
+                               "single lock")
+            elif nxt not in seen:
+                dfs(nxt, stack, onstack)
+        stack.pop()
+        onstack.discard(node)
+
+    for node in sorted(graph):
+        if node not in seen:
+            dfs(node, [], set())
+
+
+def _rule_blocking(models: Dict[str, _Module],
+                   blk: Dict[str, Tuple[str, str]],
+                   diags: List[Diagnostic]):
+    for mod in models.values():
+        for fn in mod.functions.values():
+            for held, what, lineno, _tr in fn.blocking:
+                if not held:
+                    continue
+                lock = held[-1].split(":", 1)[1]
+                _emit(diags, mod, lineno, "error", "blocking-under-lock",
+                      f"{what} while holding {lock}",
+                      hint="move the blocking call outside the critical "
+                           "section (snapshot state under the lock, "
+                           "block after releasing), or waive with "
+                           "'# thread-lint: ok blocking-under-lock' "
+                           "when the wait is the point")
+            for held, callee, lineno in fn.calls:
+                if not held:
+                    continue
+                for om, ofn in _resolve_callee(mod, fn, callee, models):
+                    k = f"{om.relpath}:{ofn.qualname}"
+                    if k in blk:
+                        what, via = blk[k]
+                        lock = held[-1].split(":", 1)[1]
+                        _emit(diags, mod, lineno, "error",
+                              "blocking-under-lock",
+                              f"call to {ofn.qualname}() may block "
+                              f"({what} via {via}) while holding {lock}",
+                              hint="release the lock before the call, "
+                                   "or waive with '# thread-lint: ok "
+                                   "blocking-under-lock'")
+
+
+def _catalog_match(site: ThreadSite) -> Optional[str]:
+    for cname, entry in THREAD_CATALOG.items():
+        if entry["module"] != site.module:
+            continue
+        if entry.get("prefix"):
+            if site.name is not None and site.name == cname:
+                return cname
+        elif site.name == cname:
+            return cname
+    return None
+
+
+def _rule_threads(models: Dict[str, _Module], diags: List[Diagnostic]):
+    sites = thread_census(models=models)
+    seen_entries: Set[str] = set()
+    for s in sites:
+        mod = next((m for m in models.values()
+                    if m.relpath == s.module), None)
+        if mod is None:
+            continue
+        if s.kind == "thread" and s.name is None:
+            _emit(diags, mod, s.lineno, "error", "thread-unnamed",
+                  f"thread created without name= (target="
+                  f"{s.target or '?'})",
+                  hint="name every background thread (pd-<subsystem>-"
+                       "<role>) so sentinel hang reports and the "
+                       "census render readable identities")
+        if s.kind == "thread" and s.daemon is not True:
+            _emit(diags, mod, s.lineno, "warning", "thread-non-daemon",
+                  f"thread '{s.name or s.target}' is not daemon=True; "
+                  f"a wedged worker would hang interpreter exit",
+                  hint="pass daemon=True unless a clean join on "
+                       "shutdown is guaranteed")
+        entry = _catalog_match(s)
+        if entry is None:
+            _emit(diags, mod, s.lineno, "error", "thread-uncataloged",
+                  f"thread creation site (name={s.name!r}) has no "
+                  f"THREAD_CATALOG entry",
+                  hint="declare it in paddle_tpu/analysis/threads.py "
+                       "THREAD_CATALOG (module, daemon, joined, help)")
+        else:
+            seen_entries.add(entry)
+            decl = THREAD_CATALOG[entry]
+            if decl.get("joined") and not s.joined and s.kind == "thread":
+                _emit(diags, mod, s.lineno, "warning",
+                      "thread-never-joined",
+                      f"catalog declares '{entry}' joined=True but no "
+                      f"join site for {s.stored_in or '?'} exists in "
+                      f"{s.module}",
+                      hint="join the handle on the shutdown path or "
+                           "declare joined=False in THREAD_CATALOG")
+        _emit(diags, mod, s.lineno, "info", "thread-census",
+              f"{s.kind} name={s.name or '<unnamed>'}"
+              f"{'*' if s.name_is_prefix else ''} "
+              f"daemon={s.daemon} target={s.target or '?'} "
+              f"joined={s.joined}"
+              + (f" reaches={','.join(s.reaches)}" if s.reaches else ""))
+    for cname, entry in THREAD_CATALOG.items():
+        if cname in seen_entries:
+            continue
+        mod = next((m for m in models.values()
+                    if m.relpath == entry["module"]), None)
+        if mod is None:
+            continue
+        _emit(diags, mod, 1, "error", "thread-catalog-stale",
+              f"THREAD_CATALOG entry '{cname}' has no matching "
+              f"Thread/go creation site in {entry['module']}",
+              hint="remove the stale entry or restore the thread name")
+
+
+# --- entry points ------------------------------------------------------------
+
+def analyze_threads(root: Optional[str] = None) -> Report:
+    """Run the full lint over the paddle_tpu tree (or `root`) and return
+    an `analysis.Report`. Never raises: an analyzer-internal failure
+    degrades to a single warning, same contract as analyze_program."""
+    diags: List[Diagnostic] = []
+    try:
+        models = build_models(root)
+        _rule_threads(models, diags)
+        _rule_mixed_guard(models, diags)
+        acq, blk = _fixpoints(models)
+        _rule_lock_order(models, acq, diags)
+        _rule_blocking(models, blk, diags)
+    except Exception as e:  # noqa: BLE001 - analyzer must not crash
+        diags.append(Diagnostic(
+            severity="warning", code="analyzer-internal",
+            message=f"thread lint failed internally: {e!r}",
+            pass_name=PASS_NAME))
+    order = {"error": 0, "warning": 1, "info": 2}
+    diags.sort(key=lambda d: (order.get(d.severity, 3), d.site or ""))
+    return Report(diags)
+
+
+def catalog_problems(root: Optional[str] = None) -> List[Tuple[str, str]]:
+    """check_registry.py surface: both-direction THREAD_CATALOG pinning
+    as (where, message) pairs."""
+    problems: List[Tuple[str, str]] = []
+    sites = thread_census(root)
+    seen: Set[str] = set()
+    for s in sites:
+        entry = _catalog_match(s)
+        if entry is None:
+            problems.append((
+                f"{s.module}:{s.lineno}",
+                f"thread creation site (kind={s.kind}, name={s.name!r}) "
+                f"not declared in THREAD_CATALOG"))
+            continue
+        seen.add(entry)
+        decl = THREAD_CATALOG[entry]
+        if s.kind == "thread" and decl.get("daemon") is not None and \
+                s.daemon is not None and bool(decl["daemon"]) != s.daemon:
+            problems.append((
+                f"{s.module}:{s.lineno}",
+                f"THREAD_CATALOG['{entry}'] declares daemon="
+                f"{decl['daemon']} but the site passes daemon={s.daemon}"))
+        if s.kind == "thread" and decl.get("joined") and not s.joined:
+            problems.append((
+                f"{s.module}:{s.lineno}",
+                f"THREAD_CATALOG['{entry}'] declares joined=True but "
+                f"no join site exists in {s.module}"))
+    for cname, entry in THREAD_CATALOG.items():
+        if cname not in seen:
+            problems.append((
+                f"analysis/threads.py THREAD_CATALOG['{cname}']",
+                f"no matching Thread/go creation site in "
+                f"{entry['module']}"))
+    return problems

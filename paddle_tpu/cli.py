@@ -491,10 +491,21 @@ def _analyze_programs(args):
 def cmd_analyze(args):
     """Static verification of a program: `python -m paddle_tpu analyze
     --example fit_a_line` / `--config conf.py --strict` / `--smoke resnet
-    --json`. Exit 1 under --strict when error-severity diagnostics exist."""
+    --json`. Exit 1 under --strict when error-severity diagnostics exist.
+    `analyze --threads` runs the thread-safety lockset lint over the
+    paddle_tpu source tree instead (exit 1 on any error finding)."""
     import json
 
     from .analysis import analyze_program
+
+    if args.threads:
+        from .analysis.threads import analyze_threads
+        report = analyze_threads()
+        if args.json:
+            print(json.dumps(report.to_dict(), indent=2))
+        else:
+            print(report.format(show_info=not args.no_info))
+        return 0 if report.ok else 1
 
     rc = 0
     payloads = []
@@ -1057,6 +1068,11 @@ def main(argv=None):
                            "is reported")
     p_an.add_argument("--no-info", action="store_true",
                       help="hide info-severity advisories")
+    p_an.add_argument("--threads", action="store_true",
+                      help="thread-safety lint over the paddle_tpu "
+                           "source tree: lockset discipline, lock-order "
+                           "cycles, blocking-under-lock, thread hygiene "
+                           "+ census (exit 1 on any error)")
     p_an.set_defaults(fn=cmd_analyze)
 
     p_srv = sub.add_parser(

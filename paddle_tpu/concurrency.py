@@ -216,13 +216,18 @@ def channel_close(channel: Channel) -> None:
     channel.close()
 
 
-def go(fn: Callable, *args, **kwargs) -> threading.Thread:
+def go(fn: Callable, *args, name: Optional[str] = None,
+       **kwargs) -> threading.Thread:
     """Launch fn concurrently — the goroutine (reference Go block,
     concurrency.py:27). The reference's `with Go():` captured an IR
     sub-block to run on executor threads; Python executes a with-body
     eagerly, so the honest host-level surface is a function launcher.
-    Returns the (daemon) thread for joining."""
-    t = threading.Thread(target=fn, args=args, kwargs=kwargs, daemon=True)
+    Returns the (daemon) thread for joining. Threads are named
+    ``pd-go-<fn name>`` (override with ``name=``) so sentinel hang
+    reports and the thread census render readable identities."""
+    t = threading.Thread(
+        target=fn, args=args, kwargs=kwargs, daemon=True,
+        name=name or f"pd-go-{getattr(fn, '__name__', 'fn')}")
     t.start()
     return t
 

@@ -284,14 +284,18 @@ def start(port: int = 0, host: str = "127.0.0.1") -> ObsServer:
 
 def stop():
     global _SERVER
+    # swap the singleton out under the lock, but run the HTTP shutdown +
+    # thread join OUTSIDE it: stop() blocks until the serve loop exits,
+    # and a concurrent start()/active() must not wedge behind that
     with _LOCK:
-        if _SERVER is not None:
-            _SERVER.stop()
-            _SERVER = None
+        s, _SERVER = _SERVER, None
+    if s is not None:
+        s.stop()
 
 
 def active() -> Optional[ObsServer]:
-    return _SERVER
+    with _LOCK:
+        return _SERVER
 
 
 def maybe_start_from_env() -> Optional[ObsServer]:

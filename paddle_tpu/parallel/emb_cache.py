@@ -203,7 +203,8 @@ class _PrefetchHandle:
                 self._err = e
             self._t1 = time.perf_counter()
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="pd-emb-prefetch")
         self._thread.start()
 
     def wait(self):
@@ -422,8 +423,11 @@ class EmbCache:
                         raise ValueError(
                             f"emb_cache: LoDTensor ids ('{n}') are not "
                             f"supported for cached table '{tname}'")
-                    arrs[n] = np.asarray(v.array() if hasattr(v, "array")
-                                         else v)
+                    # host-side id ndarrays only (never device buffers):
+                    # no device sync can hide here, and the remap must be
+                    # atomic with the slab state the lock protects
+                    arrs[n] = np.asarray(  # thread-lint: ok blocking-under-lock
+                        v.array() if hasattr(v, "array") else v)
                 uniq, counts = np.unique(
                     np.concatenate([a.ravel() for a in arrs.values()]),
                     return_counts=True)
@@ -499,7 +503,11 @@ class EmbCache:
                     continue
                 ids = t.slot2id[d]
                 for name in t.state_names:
-                    vals = np.asarray(self._slab(name)[d])
+                    # the device->host sync IS the flush barrier: rows
+                    # must land in t.host before the lock releases, or a
+                    # concurrent prepare_feed could re-stage stale rows
+                    vals = np.asarray(  # thread-lint: ok blocking-under-lock
+                        self._slab(name)[d])
                     t.host[name][ids] = vals
                     total += vals.nbytes
                 t.dirty[:] = False

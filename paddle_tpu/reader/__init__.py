@@ -110,7 +110,8 @@ def buffered(reader, size):
             finally:
                 q.put(_End)
 
-        t = threading.Thread(target=fill, daemon=True)
+        t = threading.Thread(target=fill, daemon=True,
+                             name="pd-reader-buffered")
         t.start()
         while True:
             e = q.get()

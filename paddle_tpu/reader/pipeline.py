@@ -242,7 +242,7 @@ class DoubleBufferedFeeder:
             self._wthread = threading.Thread(
                 target=self._produce_windows,
                 args=(k, device, self._wqueue, self._wstop, sparse_slots),
-                daemon=True)
+                daemon=True, name="pd-feeder-window")
             self._wthread.start()
         item = self._wqueue.get()
         if type(item) is tuple and len(item) == 2 and item[0] is _STOP:
@@ -288,7 +288,8 @@ class DoubleBufferedFeeder:
         self.stop()
         self._stop.clear()
         self._queue = queue.Queue(maxsize=self.capacity)
-        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="pd-feeder-batch")
         self._thread.start()
 
     def stop(self):

@@ -232,6 +232,13 @@ class Sentinel:
             return fired
 
     def _raise(self, name, rule, value, z, base, now):
+        # every caller today holds self._lock (RLock) via feed(); take
+        # it explicitly so the ledger mutation below can never go bare
+        # if a future caller arrives without it
+        with self._lock:
+            return self._raise_locked(name, rule, value, z, base, now)
+
+    def _raise_locked(self, name, rule, value, z, base, now):
         for entry in reversed(self._ledger):
             if entry["rule"] != name:
                 continue
@@ -450,9 +457,11 @@ class Sentinel:
                 except Exception:  # noqa: BLE001
                     pass
 
-        for name, fn in (("paddle-tpu-sentinel-poll", _poll_loop),
-                         ("paddle-tpu-sentinel-watch", _watch_loop)):
-            t = threading.Thread(target=fn, name=name, daemon=True)
+        poll_t = threading.Thread(target=_poll_loop, daemon=True,
+                                  name="paddle-tpu-sentinel-poll")
+        watch_t = threading.Thread(target=_watch_loop, daemon=True,
+                                   name="paddle-tpu-sentinel-watch")
+        for t in (poll_t, watch_t):
             t.start()
             self._threads.append(t)
         return self
@@ -481,18 +490,29 @@ def start(**kwargs) -> Sentinel:
 
 def stop():
     global _SENTINEL
+    # swap the singleton out under the lock, but join its threads
+    # OUTSIDE it: stop() blocks up to the join timeout, and a concurrent
+    # start()/arm_dispatch() must not wedge behind that
     with _LOCK:
-        if _SENTINEL is not None:
-            _SENTINEL.stop()
-            _SENTINEL = None
+        s, _SENTINEL = _SENTINEL, None
+    if s is not None:
+        s.stop()
+
+
+def _current() -> Optional[Sentinel]:
+    """Lock-free snapshot of the singleton. Executor/serving hot paths
+    do exactly one attribute read per step; the reference assignment is
+    atomic under the GIL and a momentarily stale value only skips (or
+    double-feeds) a single supervision tick."""
+    return _SENTINEL  # thread-lint: ok lockset-mixed-guard
 
 
 def active() -> Optional[Sentinel]:
-    return _SENTINEL
+    return _current()
 
 
 def enabled() -> bool:
-    return _SENTINEL is not None
+    return _current() is not None
 
 
 def reset():
@@ -502,18 +522,18 @@ def reset():
 
 def arm_dispatch(program: Optional[str] = None) -> Optional[int]:
     """Executor hook: one attribute check when the sentinel is off."""
-    s = _SENTINEL
+    s = _current()
     return None if s is None else s.arm(program)
 
 
 def disarm_dispatch(token: Optional[int]):
-    s = _SENTINEL
+    s = _current()
     if token is not None and s is not None:
         s.disarm(token)
 
 
 def hang_state() -> Optional[Dict[str, Any]]:
-    s = _SENTINEL
+    s = _current()
     return None if s is None else s.hang_state()
 
 
@@ -524,7 +544,7 @@ def alert_summary(window_s: float = 600.0,
     `window_s`) — active page-severity alerts degrade the verdict."""
     out: Dict[str, Any] = {"total": 0, "active": 0, "active_page": 0,
                            "by_severity": {}, "last": None}
-    s = _SENTINEL
+    s = _current()
     if s is None:
         return out
     now = time.time() if now is None else now
@@ -546,7 +566,7 @@ def alert_summary(window_s: float = 600.0,
 
 def alerts_payload() -> Dict[str, Any]:
     """The /alerts endpoint body; well-formed even with no sentinel."""
-    s = _SENTINEL
+    s = _current()
     return {
         "enabled": s is not None,
         "alerts": s.alerts() if s is not None else [],

@@ -144,7 +144,9 @@ class DynamicBatcher:
 
     # --- lifecycle ----------------------------------------------------------
     def start(self) -> "DynamicBatcher":
-        if self._thread is None:
+        with self._cond:
+            if self._thread is not None:
+                return self
             self._stop = False
             self._thread = threading.Thread(
                 target=self._worker, name="serving-batcher", daemon=True)
@@ -216,7 +218,7 @@ class DynamicBatcher:
                 batch.append(req)
                 rows += req.rows
             self._depth_gauge_locked()
-        self.close_counts[close] = self.close_counts.get(close, 0) + 1
+            self.close_counts[close] = self.close_counts.get(close, 0) + 1
         telemetry.counter(
             "serving_batches_total",
             "batches closed, by close cause (size-full vs deadline)",
@@ -274,11 +276,14 @@ class DynamicBatcher:
         # batch scatters results one request at a time
         scatter_t = (marks or {}).get("compute",
                                       (done_t, done_t))[1]
+        with self._cond:
+            # one bulk update, not a bare += per request: stats() reads
+            # `completed` under the condition from client threads
+            self.completed += len(live)
         for req in live:
             out = [f[off:off + req.rows] for f in fetch]
             off += req.rows
             req.future.set_result(out)
-            self.completed += 1
             hist.labels(program=self._label, phase="queue").observe(
                 pop_t - req.submit_t)
             hist.labels(program=self._label, phase="compute").observe(

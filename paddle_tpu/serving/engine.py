@@ -251,8 +251,10 @@ class ServingEngine:
                 return b
         return self.buckets[-1]
 
-    def _executable(self, bucket: int):
-        """AOT executable for one bucket — LRU cache with telemetry."""
+    def _executable_locked(self, bucket: int):
+        """AOT executable for one bucket — LRU cache with telemetry.
+        Caller holds self._lock (the `_locked` suffix is the repo's
+        convention for that contract; the thread lint enforces it)."""
         import jax
         from ..executor import _aval_of
 
@@ -312,7 +314,7 @@ class ServingEngine:
         (start, end) monotonic pairs for the pad / bucket_select /
         compute phases (+ the chosen bucket) — the tracing hook the
         batcher uses to record per-request child spans retroactively."""
-        if self._closed:
+        if self.closed:
             raise RuntimeError("ServingEngine is closed")
         t_enter = time.monotonic() if _phase_marks is not None else 0.0
         arrays = {}
@@ -353,7 +355,7 @@ class ServingEngine:
                 t_pad = time.monotonic()
                 _phase_marks["bucket"] = bucket
                 _phase_marks["pad"] = (t_enter, t_pad)
-            ex = self._executable(bucket)
+            ex = self._executable_locked(bucket)
             if _phase_marks is not None:
                 t_sel = time.monotonic()
                 _phase_marks["bucket_select"] = (t_pad, t_sel)
@@ -362,7 +364,8 @@ class ServingEngine:
             if _phase_marks is not None:
                 _phase_marks["compute"] = (t_sel, time.monotonic())
             self._state = new_state
-        self.bucket_runs[bucket] = self.bucket_runs.get(bucket, 0) + 1
+            self.bucket_runs[bucket] = \
+                self.bucket_runs.get(bucket, 0) + 1
         telemetry.counter(
             "serving_bucket_runs_total",
             "batches executed per bucket",
@@ -377,7 +380,7 @@ class ServingEngine:
         pruned program."""
         from ..executor import LoDTensor, scope_guard
 
-        if self._closed:
+        if self.closed:
             raise RuntimeError("ServingEngine is closed")
         if any(isinstance(feed.get(n), LoDTensor) and feed[n].lod
                for n in self.feed_names):
@@ -407,21 +410,26 @@ class ServingEngine:
 
     # --- lifecycle / introspection ------------------------------------------
     def stats(self) -> Dict[str, object]:
-        out = {
-            "cache_hits": self.cache_hits,
-            "cache_misses": self.cache_misses,
-            "evictions": self.evictions,
-            "bucket_runs": dict(self.bucket_runs),
-            "buckets": list(self.buckets),
-            "resident_state": len(self._state or ()),
-        }
+        # under the run lock: counters and resident state are mutated by
+        # the batcher worker mid-run_batch, and stats() is called from
+        # client/monitoring threads
+        with self._lock:
+            out = {
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "evictions": self.evictions,
+                "bucket_runs": dict(self.bucket_runs),
+                "buckets": list(self.buckets),
+                "resident_state": len(self._state or ()),
+            }
         if self._emb_cache is not None:
             out["emb_cache"] = self._emb_cache.stats()
         return out
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._lock:
+            return self._closed
 
     def close(self):
         """Destroy-handle semantics (C-API `paddle_tpu_machine_destroy`):

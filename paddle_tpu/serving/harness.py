@@ -73,7 +73,8 @@ def run_load(batcher, make_feed: Callable[[int, int], Dict],
                 ok[0] += 1
                 latencies_ms.append(dt_ms)
 
-    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+    threads = [threading.Thread(target=client, args=(i,), daemon=True,
+                                name=f"pd-serving-client-{i}")
                for i in range(clients)]
     # hang watchdog over the whole load phase (a wedged engine shows up
     # as a sentinel hang report, not a silent stuck join); no-op fast

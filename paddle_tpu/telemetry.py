@@ -215,8 +215,10 @@ class MetricsRegistry:
     def generation(self) -> int:
         """Bumped by clear()/reset(); lets hot paths that cache a family or
         child handle (profiler.record_event) self-invalidate with one int
-        compare instead of re-resolving through the registry lock."""
-        return self._generation
+        compare instead of re-resolving through the registry lock.
+        Deliberately lock-free: int loads are atomic under the GIL and a
+        stale read only costs one redundant re-resolve."""
+        return self._generation  # thread-lint: ok lockset-mixed-guard
 
     # --- snapshots ----------------------------------------------------------
     def local_snapshot(self) -> Dict[str, Any]:
@@ -494,24 +496,29 @@ def enable_step_log(path: str):
     """Mirror every event to `path` as one JSON line per event (in addition
     to the in-memory ring buffer). Also settable via PADDLE_TPU_STEP_LOG."""
     global _log_path, _log_file
+    # open() hits the filesystem — do it before taking the lock so a
+    # slow/hung open can't stall every concurrent log_event(); only the
+    # reference swap happens under _events_lock
+    f = open(path, "a", buffering=1)   # line-buffered
     with _events_lock:
-        if _log_file is not None:
-            _log_file.close()
+        old, _log_file = _log_file, f
         _log_path = path
-        _log_file = open(path, "a", buffering=1)   # line-buffered
+    if old is not None:
+        old.close()
 
 
 def disable_step_log():
     global _log_path, _log_file
     with _events_lock:
-        if _log_file is not None:
-            _log_file.close()
+        old, _log_file = _log_file, None
         _log_path = None
-        _log_file = None
+    if old is not None:
+        old.close()
 
 
 def step_log_path() -> Optional[str]:
-    return _log_path
+    with _events_lock:
+        return _log_path
 
 
 def log_event(kind: str, **fields) -> Dict[str, Any]:
@@ -597,6 +604,7 @@ def _json_ok(v) -> bool:
 
 _prog_labels: Dict[int, str] = {}
 _prog_seq = [0]
+_prog_lock = threading.Lock()
 
 
 def program_label(program) -> str:
@@ -604,12 +612,17 @@ def program_label(program) -> str:
     — id() is unreadable and Programs carry no user-facing name."""
     lbl = getattr(program, "_telemetry_label", None)
     if lbl is None:
-        lbl = f"p{_prog_seq[0]}"
-        _prog_seq[0] += 1
-        try:
-            program._telemetry_label = lbl
-        except AttributeError:
-            pass
+        # the seq bump is a read-modify-write; two threads labelling
+        # concurrently must not mint the same "pN"
+        with _prog_lock:
+            lbl = getattr(program, "_telemetry_label", None)
+            if lbl is None:
+                lbl = f"p{_prog_seq[0]}"
+                _prog_seq[0] += 1
+                try:
+                    program._telemetry_label = lbl
+                except AttributeError:
+                    pass
     return lbl
 
 

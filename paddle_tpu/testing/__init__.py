@@ -1,0 +1,21 @@
+"""Testing utilities: deterministic concurrency harness.
+
+`paddle_tpu.testing.interleave` is the dynamic half of the repo's
+thread-safety tooling (the static half is
+`paddle_tpu.analysis.threads`): a seeded cooperative scheduler that
+forces preemption at shared-state access points so data races become
+reproducible test failures instead of one-in-a-thousand flakes."""
+
+from .interleave import (  # noqa: F401
+    DropCountFixture,
+    InterleaveResult,
+    explore,
+    run_interleaved,
+)
+
+__all__ = [
+    "DropCountFixture",
+    "InterleaveResult",
+    "explore",
+    "run_interleaved",
+]

@@ -1,0 +1,440 @@
+"""Deterministic interleaving harness: seeded cooperative scheduling.
+
+CHESS/loom-style systematic schedule exploration, specialized to
+CPython: a `sys.settrace`-based cooperative scheduler that serializes
+the watched threads and forces a preemption decision at every traced
+line — i.e. at every shared-state access point inside the watched
+files. The schedule is drawn from `random.Random(seed)`, so
+
+  * a failing interleaving is **replayable**: re-running with the
+    recorded seed yields the same schedule and the same failure;
+  * `explore()` sweeps seeds until an invariant breaks, turning
+    "this race fires once in a thousand runs under load" into "seed 17
+    fails, every time".
+
+How it works
+------------
+`run_interleaved(workers, seed=...)` starts one thread per worker.
+Each thread installs a trace function whose 'line' events call back
+into the scheduler (`_checkpoint`): the thread parks and waits for the
+scheduler's grant. Exactly one thread runs between checkpoints; at
+each checkpoint the scheduler picks the next runnable thread with the
+seeded RNG. Threads the *subsystem under test* spawns are adopted via
+`threading.settrace` the moment they execute a watched line, so real
+pipeline/batcher/prefetch threads participate in the schedule too.
+
+A thread that blocks in a real primitive (lock, queue, join) while
+holding the grant cannot park; after a short grace period the
+scheduler *detaches* it — it runs free (the OS scheduler interleaves
+it) until its next watched line, where it re-attaches. This keeps the
+harness deadlock-free over code that genuinely blocks, at the cost of
+a bounded nondeterminism window; drives that want bit-exact replay
+(e.g. the planted `DropCountFixture`) use spin-waits over plain lists
+so every wait is itself a traced checkpoint.
+
+Watched files default to the files defining the worker callables;
+pass `watch=[module_or_path, ...]` to trace a subsystem's internals
+(e.g. `watch=[paddle_tpu.reader.pipeline]`).
+
+The planted fixture
+-------------------
+`DropCountFixture` reproduces the PR 17 drop-count race class (see
+reader/pipeline.py `_produce_windows`: the end-of-pass drop count must
+ride the stop marker so the consumer books it; the pre-fix builder
+published the stop marker first and counted after, so a fast consumer
+read 0). `buggy=True` plants that exact ordering; the harness is
+required to find a seed that observes the lost count, and to
+reproduce it deterministically from that seed. `buggy=False` is the
+shipped ordering and survives every seed.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from types import ModuleType
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "DropCountFixture",
+    "InterleaveResult",
+    "explore",
+    "run_interleaved",
+]
+
+
+# ---------------------------------------------------------------------------
+# result object
+# ---------------------------------------------------------------------------
+
+@dataclass
+class InterleaveResult:
+    """Outcome of one scheduled run."""
+    seed: int
+    #: executed schedule: (thread name, "file:line") per granted step
+    schedule: List[Tuple[str, str]] = field(default_factory=list)
+    #: per-thread exception (worker body raised), by thread name
+    errors: Dict[str, BaseException] = field(default_factory=dict)
+    steps: int = 0
+    #: True when max_steps fired and the tail ran unscheduled
+    truncated: bool = False
+    #: threads still alive at the overall deadline (name -> stack text)
+    stuck: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors and not self.stuck
+
+    def first_error(self) -> Optional[BaseException]:
+        for name in sorted(self.errors):
+            return self.errors[name]
+        return None
+
+    def signature(self) -> Tuple[Tuple[str, str], ...]:
+        """Hashable schedule fingerprint for determinism assertions."""
+        return tuple(self.schedule)
+
+
+# ---------------------------------------------------------------------------
+# the scheduler
+# ---------------------------------------------------------------------------
+
+class _ThreadState:
+    __slots__ = ("name", "parked", "finished", "detached", "where",
+                 "adopted")
+
+    def __init__(self, name: str, adopted: bool = False):
+        self.name = name
+        self.parked = False
+        self.finished = False
+        self.detached = False
+        self.where = "?"
+        self.adopted = adopted
+
+
+def _watch_files(workers, watch) -> Tuple[str, ...]:
+    files = []
+    for _, fn in workers:
+        code = getattr(fn, "__code__", None)
+        if code is not None:
+            files.append(code.co_filename)
+    for w in watch or ():
+        if isinstance(w, ModuleType):
+            f = getattr(w, "__file__", None)
+            if f:
+                files.append(f)
+        else:
+            files.append(str(w))
+    return tuple(dict.fromkeys(files))
+
+
+class _Scheduler:
+    def __init__(self, workers, seed: int, watch, max_steps: int,
+                 grace_s: float, deadline_s: float,
+                 sticky: float = 0.9):
+        self.rng = random.Random(seed)
+        # probability of NOT preempting the running thread at a
+        # checkpoint. Most ordering bugs need only one or two
+        # preemptions placed exactly right (the CHESS observation), so
+        # long runs with rare, randomly-placed switches find them far
+        # faster than a uniform coin flip per line
+        self.sticky = sticky
+        self.result = InterleaveResult(seed=seed)
+        self.max_steps = max_steps
+        self.grace_s = grace_s
+        self.deadline_s = deadline_s
+        self.watch = _watch_files(workers, watch)
+        self._cv = threading.Condition()
+        self._threads: Dict[int, _ThreadState] = {}
+        self._grant: Optional[int] = None
+        self._released = False
+        self._adopt_seq = 0
+        self._workers = workers
+        # the harness's own machinery runs on the worker threads inside
+        # a watched file — it must never checkpoint (a thread parked
+        # mid-registration would deadlock the startup barrier)
+        self._own_code = {
+            type(self)._bootstrap.__code__,
+            _ThreadState.__init__.__code__,
+        }
+
+    # -- trace plumbing (runs on the worker threads) --
+
+    def _global_trace(self, frame, event, arg):
+        if event != "call":
+            return None
+        if frame.f_code in self._own_code:
+            return None
+        fname = frame.f_code.co_filename
+        for w in self.watch:
+            if fname.endswith(w) or w in fname:
+                return self._local_trace
+        return None
+
+    def _local_trace(self, frame, event, arg):
+        if event == "line":
+            self._checkpoint(frame)
+        return self._local_trace
+
+    def _checkpoint(self, frame):
+        tid = threading.get_ident()
+        with self._cv:
+            if self._released:
+                return
+            st = self._threads.get(tid)
+            if st is None:
+                # a thread the subsystem spawned just executed a watched
+                # line: adopt it into the schedule
+                self._adopt_seq += 1
+                st = _ThreadState(
+                    threading.current_thread().name
+                    or f"adopted-{self._adopt_seq}", adopted=True)
+                self._threads[tid] = st
+            st.detached = False
+            st.parked = True
+            st.where = (f"{frame.f_code.co_filename.rsplit('/', 1)[-1]}"
+                        f":{frame.f_lineno}")
+            self._cv.notify_all()
+            while self._grant != tid and not self._released:
+                self._cv.wait(0.5)
+            if self._released:
+                st.parked = False
+                return
+            self._grant = None
+            st.parked = False
+            self.result.schedule.append((st.name, st.where))
+            self.result.steps += 1
+            if self.result.steps >= self.max_steps:
+                self.result.truncated = True
+                self._released = True
+                self._cv.notify_all()
+
+    def _bootstrap(self, fn, name):
+        tid = threading.get_ident()
+        with self._cv:
+            # self-registration: the thread is in the schedule before
+            # its first traced line can possibly fire
+            self._threads[tid] = _ThreadState(name)
+            self._cv.notify_all()
+        sys.settrace(self._global_trace)
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 - reported in result
+            with self._cv:
+                self.result.errors[self._threads[tid].name] = e
+        finally:
+            sys.settrace(None)
+            with self._cv:
+                self._threads[tid].finished = True
+                self._threads[tid].parked = False
+                self._cv.notify_all()
+
+    # -- the schedule loop (runs on the caller thread) --
+
+    def run(self) -> InterleaveResult:
+        threads = []
+        prev_threading_trace = getattr(threading, "_trace_hook", None)
+        threading.settrace(self._global_trace)
+        try:
+            for name, fn in self._workers:
+                t = threading.Thread(
+                    target=self._bootstrap, args=(fn, name), daemon=True,
+                    name=f"ilv-{name}")
+                t.start()
+                threads.append(t)
+            self._loop()
+        finally:
+            threading.settrace(prev_threading_trace)
+            with self._cv:
+                self._released = True
+                self._cv.notify_all()
+            for t in threads:
+                t.join(timeout=self.deadline_s)
+            for t in threads:
+                if t.is_alive():
+                    frames = sys._current_frames()
+                    fr = frames.get(t.ident)
+                    self.result.stuck[t.name] = (
+                        "".join(traceback.format_stack(fr))
+                        if fr is not None else "<no frame>")
+        return self.result
+
+    def _loop(self):
+        deadline = time.monotonic() + self.deadline_s
+        last: Optional[int] = None
+        with self._cv:
+            # startup barrier: no grant until every worker has
+            # registered AND parked at its first checkpoint — otherwise
+            # the first decisions see a partial thread set and the
+            # schedule depends on OS startup timing instead of the seed
+            t0 = time.monotonic()
+            while True:
+                own = [s for s in self._threads.values()
+                       if not s.adopted]
+                if len(own) == len(self._workers) and \
+                        all(s.parked or s.finished for s in own):
+                    break
+                if time.monotonic() - t0 > self.deadline_s:
+                    break
+                self._cv.wait(0.05)
+            while not self._released:
+                live = [s for s in self._threads.values()
+                        if not s.finished]
+                own = [s for s in self._threads.values()
+                       if not s.adopted and not s.finished]
+                if not own:
+                    return          # every worker done; adopted run free
+                parked = sorted(
+                    (tid for tid, s in self._threads.items()
+                     if s.parked),
+                    key=lambda tid: self._threads[tid].name)
+                if not parked:
+                    # everything is running or blocked in a real
+                    # primitive; wait for someone to park or finish
+                    if not self._cv.wait(self.grace_s) and \
+                            time.monotonic() > deadline:
+                        return      # watchdog: stuck set reported by run()
+                    continue
+                # sticky choice: keep the last thread running unless the
+                # (seeded) coin says preempt; both branches consume RNG
+                # deterministically as a function of the history
+                if last in parked and len(parked) > 1 and \
+                        self.rng.random() < self.sticky:
+                    tid = last
+                else:
+                    tid = parked[self.rng.randrange(len(parked))]
+                last = tid
+                st = self._threads[tid]
+                self._grant = tid
+                self._cv.notify_all()
+                t0 = time.monotonic()
+                while not self._released:
+                    # the grant is consumed (the thread cleared it and
+                    # unparked) AND the thread is back at a checkpoint
+                    # or done: its slice is over, schedule the next one
+                    if self._grant != tid and (st.parked or st.finished):
+                        break
+                    remaining = self.grace_s - (time.monotonic() - t0)
+                    if remaining <= 0:
+                        # blocked for real (lock/queue/join): detach so
+                        # another thread can unblock it; it re-attaches
+                        # at its next watched line
+                        st.detached = True
+                        if self._grant == tid:
+                            self._grant = None
+                        break
+                    self._cv.wait(remaining)
+                if time.monotonic() > deadline:
+                    return
+
+
+def run_interleaved(workers: Iterable, *, seed: int = 0,
+                    watch: Optional[Iterable] = None,
+                    max_steps: int = 20000, grace_s: float = 0.05,
+                    deadline_s: float = 20.0,
+                    sticky: float = 0.9) -> InterleaveResult:
+    """Run `workers` under a seeded cooperative schedule.
+
+    workers: callables, or (name, callable) pairs. watch: extra modules
+    or path substrings whose lines become preemption points (defaults
+    to the files defining the workers). Returns an InterleaveResult;
+    worker exceptions land in result.errors, they do not propagate.
+    """
+    norm = []
+    for i, w in enumerate(workers):
+        if isinstance(w, tuple):
+            norm.append((str(w[0]), w[1]))
+        else:
+            norm.append((getattr(w, "__name__", f"w{i}") or f"w{i}", w))
+    sched = _Scheduler(norm, seed, watch, max_steps, grace_s, deadline_s,
+                       sticky=sticky)
+    return sched.run()
+
+
+def explore(build: Callable[[], Tuple[Iterable, Optional[Callable]]],
+            seeds: Iterable[int] = range(32), *,
+            stop_at_first: bool = True,
+            **run_kw) -> List[Tuple[int, BaseException,
+                                    InterleaveResult]]:
+    """Sweep seeds until an invariant breaks.
+
+    `build()` returns (workers, check): fresh workers over fresh state,
+    plus an optional post-run invariant callable that raises on
+    violation. Returns [(seed, error, result), ...] — the recorded seed
+    replays the failure via run_interleaved(..., seed=seed).
+    """
+    failures = []
+    for seed in seeds:
+        workers, check = build()
+        res = run_interleaved(workers, seed=seed, **run_kw)
+        err = res.first_error()
+        if err is None and check is not None:
+            try:
+                check()
+            except BaseException as e:  # noqa: BLE001 - the point
+                err = e
+        if err is not None:
+            failures.append((seed, err, res))
+            if stop_at_first:
+                break
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# planted fixture: the PR 17 drop-count race class
+# ---------------------------------------------------------------------------
+
+class DropCountFixture:
+    """Builder/consumer pair planting the drop-count ordering bug.
+
+    The builder ends a pass with `remainder` dropped batches: it must
+    make the count visible BEFORE (or atomically with) the stop marker,
+    because the consumer books the count at the pull that observes the
+    stop. buggy=True publishes the marker first and counts after — the
+    planted defect; buggy=False is the shipped ordering.
+
+    All coordination is spin-waiting over plain lists: every wait is a
+    traced line, so the schedule (and therefore the failure) is a pure
+    function of the seed.
+    """
+
+    def __init__(self, buggy: bool = True, remainder: int = 3):
+        self.buggy = buggy
+        self.remainder = remainder
+        self.mailbox: List[object] = []   # the window queue stand-in
+        self.dropped = 0                  # the racy counter
+        self.observed: Optional[int] = None
+
+    def builder(self):
+        self.mailbox.append("window-0")
+        if self.buggy:
+            self.mailbox.append("STOP")
+            self.dropped += self.remainder   # counted AFTER publication
+        else:
+            self.dropped += self.remainder   # count rides the marker
+            self.mailbox.append("STOP")
+
+    def consumer(self):
+        taken = 0
+        while True:
+            while len(self.mailbox) <= taken:
+                pass                      # traced spin: a checkpoint
+            item = self.mailbox[taken]
+            taken += 1
+            if item == "STOP":
+                self.observed = self.dropped
+                return
+
+    def check(self):
+        if self.observed != self.remainder:
+            raise AssertionError(
+                f"drop-count race: consumer booked {self.observed} "
+                f"dropped batches at STOP, builder dropped "
+                f"{self.remainder}")
+
+    def workers(self):
+        return [("builder", self.builder), ("consumer", self.consumer)]

@@ -155,12 +155,13 @@ def _sample_root() -> bool:
     crosses 1.0 (an error-feedback quantizer — exact long-run rate,
     no RNG so traces are reproducible)."""
     global _SAMPLE_ACC
-    if _SAMPLE >= 1.0:
-        return True
-    if _SAMPLE <= 0.0:
-        return False
     with _LOCK:
-        _SAMPLE_ACC += _SAMPLE
+        s = _SAMPLE
+        if s >= 1.0:
+            return True
+        if s <= 0.0:
+            return False
+        _SAMPLE_ACC += s
         if _SAMPLE_ACC >= 1.0:
             _SAMPLE_ACC -= 1.0
             return True
@@ -200,11 +201,12 @@ def enable(sample: float = 1.0, capacity: Optional[int] = None,
     `capacity` bounds the finished-span ring; `jsonl` mirrors finished
     spans to a file, one JSON object per line."""
     global _ENABLED, _SAMPLE, _CAPACITY, _JSONL_PATH
-    _SAMPLE = min(max(float(sample), 0.0), 1.0)
-    if capacity is not None:
-        _CAPACITY = max(int(capacity), 1)
-    if jsonl is not None:
-        _JSONL_PATH = jsonl
+    with _LOCK:
+        _SAMPLE = min(max(float(sample), 0.0), 1.0)
+        if capacity is not None:
+            _CAPACITY = max(int(capacity), 1)
+        if jsonl is not None:
+            _JSONL_PATH = jsonl
     _ENABLED = True
 
 
@@ -223,10 +225,10 @@ def reset():
     with _LOCK:
         _SPANS = []
         _SAMPLE_ACC = 0.0
+        _SAMPLE = 1.0
+        _CAPACITY = _DEFAULT_CAPACITY
+        _JSONL_PATH = None
     _ENABLED = False
-    _SAMPLE = 1.0
-    _CAPACITY = _DEFAULT_CAPACITY
-    _JSONL_PATH = None
     _LOCAL.stack = []
 
 

@@ -442,3 +442,62 @@ def test_metric_lint_catches_reader_label_drift(monkeypatch):
                for _, m in problems) or \
         any("executor_last_step_seconds" in m and "drift" in m
             for _, m in problems), problems
+
+
+def test_thread_catalog_consistent():
+    """ISSUE 18 satellite: every Thread/go creation site in paddle_tpu/
+    matches a THREAD_CATALOG entry and every entry matches a site, with
+    daemon/joined declarations pinned to what the source actually does."""
+    problems = _load_checker().check_thread_catalog()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_thread_lint_catches_uncataloged_site(monkeypatch):
+    """Deleting a catalog entry must surface its creation site as
+    undeclared — new background threads can't ship uncensused."""
+    from paddle_tpu.analysis import threads
+
+    checker = _load_checker()
+    monkeypatch.delitem(threads.THREAD_CATALOG, "serving-batcher")
+    problems = checker.check_thread_catalog()
+    assert any("batcher.py" in w and "not declared" in m
+               for w, m in problems), problems
+
+
+def test_thread_lint_catches_stale_entry(monkeypatch):
+    """A catalog entry whose creation site no longer exists is stale
+    documentation; the lint must flag it for removal."""
+    from paddle_tpu.analysis import threads
+
+    checker = _load_checker()
+    monkeypatch.setitem(
+        threads.THREAD_CATALOG, "pd-phantom-",
+        dict(module="paddle_tpu/phantom.py", prefix=True, daemon=True,
+             joined=False, help="never created"))
+    problems = checker.check_thread_catalog()
+    assert any("pd-phantom-" in w and "no matching" in m
+               for w, m in problems), problems
+
+
+def test_thread_lint_catches_daemon_and_join_drift(monkeypatch):
+    """Flipping declared daemon-ness or claiming a join that doesn't
+    exist must both trip: the catalog documents lifetime contracts."""
+    from paddle_tpu.analysis import threads
+
+    checker = _load_checker()
+    monkeypatch.setitem(
+        threads.THREAD_CATALOG, "serving-batcher",
+        dict(threads.THREAD_CATALOG["serving-batcher"], daemon=False))
+    problems = checker.check_thread_catalog()
+    assert any("daemon" in m and "serving-batcher" in m
+               for _, m in problems), problems
+
+    monkeypatch.setitem(
+        threads.THREAD_CATALOG, "serving-batcher",
+        dict(threads.THREAD_CATALOG["serving-batcher"], daemon=True))
+    monkeypatch.setitem(
+        threads.THREAD_CATALOG, "pd-reader-buffered",
+        dict(threads.THREAD_CATALOG["pd-reader-buffered"], joined=True))
+    problems = checker.check_thread_catalog()
+    assert any("joined=True" in m and "no join site" in m
+               for _, m in problems), problems

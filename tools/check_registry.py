@@ -763,6 +763,21 @@ def check_alert_rules():
     return problems
 
 
+def check_thread_catalog():
+    """[(where, message), ...] — pin analysis/threads.THREAD_CATALOG
+    against the actual `threading.Thread`/`go()` creation sites in
+    paddle_tpu/ in both directions (ISSUE 18 satellite). An uncataloged
+    thread has no declared lifetime discipline (daemon? joined by its
+    owner?) and renders anonymously in sentinel hang reports; a stale
+    catalog entry documents a thread that no longer exists. Declared
+    daemon/joined flags are also checked against what the census can
+    prove at each site, so the catalog can't quietly drift into
+    documenting the wrong shutdown contract."""
+    from paddle_tpu.analysis import threads
+
+    return threads.catalog_problems()
+
+
 def main():
     problems = check_tables()
     for tname, name in problems:
@@ -797,8 +812,11 @@ def main():
     alerts = check_alert_rules()
     for where, msg in alerts:
         print(f"{where}: {msg}")
+    thrc = check_thread_catalog()
+    for where, msg in thrc:
+        print(f"{where}: {msg}")
     problems = problems + coll + jit + sparse + embc + pallas + inferp \
-        + servp + plroles + metrics + alerts
+        + servp + plroles + metrics + alerts + thrc
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")
