@@ -224,6 +224,50 @@ def _dispatch_overhead_ms(run_step, k, n=10):
         return None
 
 
+def _dynamics_overhead_fraction(run_step, n=12, reps=3, warm=16):
+    """Measured cost of the training-dynamics observatory's fused
+    on-device reduction (dynamics.py), as a fraction of step time:
+    per-step wall with dynamics on vs off, alternating `reps` A/B rounds
+    and keeping each arm's MINIMUM (the same noise discipline as
+    bench_diff's better-of-N). Flipping dynamics.override changes the
+    executor's jit cache token, so the arms are distinct executables —
+    and fresh XLA executables run slow for their first ~20 calls (same
+    effect the roofline probe warms through), so each arm drains `warm`
+    steps before its first timed round; without that the off-arm
+    inherits the main loop's warmth and the comparison reads pure
+    warmup as overhead. Best-effort — never kills the bench line. The
+    acceptance bar is < 0.02 (ISSUE 19)."""
+    try:
+        from paddle_tpu import dynamics as dynamics_mod
+
+        warmed = set()
+
+        def _arm(enabled):
+            with dynamics_mod.override(enabled):
+                out = run_step()
+                float(np.asarray(out).ravel()[0])    # compile + drain
+                if enabled not in warmed:
+                    warmed.add(enabled)
+                    for _ in range(warm):
+                        out = run_step()
+                    float(np.asarray(out).ravel()[0])
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    out = run_step()
+                float(np.asarray(out).ravel()[0])
+                return (time.perf_counter() - t0) / n
+
+        offs, ons = [], []
+        for _ in range(reps):
+            offs.append(_arm(False))
+            ons.append(_arm(True))
+        t_off, t_on = min(offs), min(ons)
+        return round(max(t_on - t_off, 0.0) / t_off, 4)
+    except Exception as e:  # noqa: BLE001 - metric is best-effort
+        sys.stderr.write(f"dynamics-overhead probe failed: {e}\n")
+        return None
+
+
 def _auto_steps_per_call(exe, prog, run_step, feed, fetch):
     """`--steps-per-call auto`: measure the per-dispatch Python overhead
     and per-step device time on the already-compiled K=1 path, bound the
@@ -783,6 +827,7 @@ def main_fc():
         "steps_per_call_mode": ("auto" if STEPS_PER_CALL == "auto"
                                 else "fixed"),
         "python_overhead_per_step_ms": _dispatch_overhead_ms(step, k),
+        "dynamics_overhead_fraction": _dynamics_overhead_fraction(step),
         "mfu": round(mfu, 4),
     }, errors)
 

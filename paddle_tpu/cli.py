@@ -676,6 +676,8 @@ def cmd_obs(args):
     st_metrics, metrics_body = get("/metrics")
     _st_health, health_body = get("/healthz")
     st_spans, spans_body = get("/spans?n=8")
+    st_dyn, dyn_body = get("/dynamics?n=4")
+    dyn = json.loads(dyn_body)
     summary = {
         "port": srv.port,
         "steps": args.steps,
@@ -684,6 +686,9 @@ def cmd_obs(args):
         "spans": {"status": st_spans,
                   "returned": len(json.loads(spans_body)["spans"]),
                   "buffered": len(tracing.recent_spans())},
+        "dynamics": {"status": st_dyn, "enabled": dyn.get("enabled"),
+                     "samples": dyn.get("samples_recorded"),
+                     "programs": len(dyn.get("programs") or {})},
     }
     if args.export_trace:
         n = tracing.export_chrome_trace(args.export_trace)
@@ -698,7 +703,8 @@ def cmd_obs(args):
         except KeyboardInterrupt:
             pass
     obs_server.stop()
-    return 0 if st_metrics == 200 and st_spans == 200 else 1
+    return 0 if st_metrics == 200 and st_spans == 200 \
+        and st_dyn == 200 else 1
 
 
 def cmd_sentinel(args):
@@ -765,6 +771,131 @@ def cmd_sentinel(args):
     ok = (a1 is not None and a2 is not None
           and hang is not None and recovered)
     return 0 if ok else 1
+
+
+def cmd_dynamics(args):
+    """Training-dynamics observatory (dynamics.py).
+
+    --smoke trains a small program with a PLANTED dead layer (an fc whose
+    output is multiplied by 0.0, so its grads are exactly zero) and a
+    PLANTED update spike (the feed magnitude jumps late in the run, the
+    moral equivalent of an LR spike), polling the run sentinel each step
+    and serving /dynamics over real HTTP. Exits 0 iff the dead-layer
+    verdict fires, the dynamics_update_ratio_spike sentinel alert fires,
+    and /dynamics serves the series. --json prints the full observatory
+    payload; --watch reprints the verdict table every --interval s."""
+    import json
+
+    from paddle_tpu import dynamics as dynamics_mod
+
+    if args.json and not args.smoke:
+        print(json.dumps(dynamics_mod.payload(recent=args.recent),
+                         sort_keys=True, default=str))
+        return 0
+    if args.watch and not args.smoke:
+        try:
+            while True:
+                p = dynamics_mod.payload(recent=1)
+                verd = p.get("verdicts") or []
+                print(f"dynamics: {p['samples_recorded']} samples, "
+                      f"{len(verd)} non-ok verdict(s)", file=sys.stderr)
+                for v in verd:
+                    print(f"  {v['program']}/{v['series']}: {v['code']}",
+                          file=sys.stderr)
+                time_mod.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+    import http.client
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import executor as executor_mod
+    from paddle_tpu import obs_server
+    from paddle_tpu import sentinel as sentinel_mod
+    from paddle_tpu.framework import unique_name
+
+    with unique_name.guard():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            live = fluid.layers.fc(input=x, size=8, act="relu")
+            dead = fluid.layers.fc(input=x, size=8, act="relu")
+            # the planted dead layer: x0.0 kills its gradient exactly
+            h = live + fluid.layers.scale(dead, scale=0.0)
+            pred = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(
+                loss, startup_program=startup)
+
+    sent = sentinel_mod.active() or sentinel_mod.start(interval_s=3600.0)
+    srv = obs_server.start(port=args.port)
+    print(f"dynamics: serving http://127.0.0.1:{srv.port}/dynamics",
+          file=sys.stderr)
+
+    rng = np.random.RandomState(7)
+    spike_at = args.steps - 4
+    with dynamics_mod.override(True, 1), \
+            executor_mod.scope_guard(executor_mod.Scope()):
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        for i in range(args.steps):
+            xb = rng.randn(args.batch, 8).astype(np.float32)
+            if i >= spike_at:
+                xb = xb * 8.0       # the planted update spike
+            yb = rng.randn(args.batch, 1).astype(np.float32)
+            exe.run(main_prog, feed={"x": xb, "y": yb},
+                    fetch_list=[loss])
+            sent.poll()
+
+    verd = dynamics_mod.verdicts()
+    dead_fired = any(v["code"] == "dead-layer" for v in verd)
+    rules_fired = sorted({a["rule"] for a in sent.alerts()
+                          if a["rule"].startswith("dynamics_")})
+    spike_fired = "dynamics_update_ratio_spike" in rules_fired
+
+    def get(route):
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=10)
+        try:
+            conn.request("GET", route)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    st_dyn, dyn_body = get("/dynamics?n=4")
+    served = json.loads(dyn_body) if st_dyn == 200 else {}
+    http_ok = st_dyn == 200 and bool(served.get("programs"))
+
+    for v in verd:
+        print(f"[verdict] {v['program']}/{v['series']} [{v['role']}]: "
+              f"{v['code']}", file=sys.stderr)
+    for a in sent.alerts():
+        if a["rule"].startswith("dynamics_"):
+            print(f"[alert] {a['rule']} severity={a['severity']} "
+                  f"value={a['value']:.4g} z={a['zscore']:.1f}",
+                  file=sys.stderr)
+
+    summary = {
+        "steps": args.steps,
+        "dead_layer_verdict": dead_fired,
+        "update_ratio_alert": spike_fired,
+        "dynamics_rules_fired": rules_fired,
+        "verdicts": [f"{v['program']}/{v['series']}:{v['code']}"
+                     for v in verd],
+        "http": {"status": st_dyn,
+                 "programs": len(served.get("programs") or {}),
+                 "samples": served.get("samples_recorded")},
+    }
+    if args.json:
+        summary["payload"] = dynamics_mod.payload(recent=args.recent)
+    print(json.dumps(summary, sort_keys=True, default=str))
+    obs_server.stop()
+    return 0 if dead_fired and spike_fired and http_ok else 1
 
 
 def cmd_version(_args):
@@ -1148,6 +1279,34 @@ def main(argv=None):
     p_sent.add_argument("--interval", type=float, default=5.0,
                         help="live poll interval seconds (default 5)")
     p_sent.set_defaults(fn=cmd_sentinel)
+
+    p_dyn = sub.add_parser(
+        "dynamics", help="training-dynamics observatory: per-layer "
+                         "weight/grad/update-ratio health; --smoke "
+                         "plants a dead layer + update spike and "
+                         "checks the verdicts, alerts and /dynamics")
+    p_dyn.add_argument("--smoke", action="store_true",
+                       help="train the planted-failure program, print "
+                            "verdicts/alerts, exit 0 iff all fire")
+    p_dyn.add_argument("--json", action="store_true",
+                       help="print the observatory payload as JSON "
+                            "(with --smoke: appended to the summary)")
+    p_dyn.add_argument("--watch", action="store_true",
+                       help="reprint the verdict table every --interval "
+                            "seconds until Ctrl-C")
+    p_dyn.add_argument("--steps", type=int, default=24,
+                       help="smoke steps (default 24; the last 4 carry "
+                            "the planted spike)")
+    p_dyn.add_argument("--batch", type=int, default=16,
+                       help="smoke batch size (default 16)")
+    p_dyn.add_argument("--port", type=int, default=0,
+                       help="obs-server port for /dynamics (default 0 = "
+                            "ephemeral)")
+    p_dyn.add_argument("--recent", type=int, default=16,
+                       help="rows per series in --json output")
+    p_dyn.add_argument("--interval", type=float, default=2.0,
+                       help="--watch refresh seconds (default 2)")
+    p_dyn.set_defaults(fn=cmd_dynamics)
 
     p_ver = sub.add_parser("version")
     p_ver.set_defaults(fn=cmd_version)

@@ -22,15 +22,20 @@ class DataToLoDTensorConverter:
         self.lod = [[0] for _ in range(lod_level)]
 
     def feed(self, data):
-        self._feed_impl_(data, self.lod, self.lod_level)
-
-    def _feed_impl_(self, data, lod, lod_level):
-        if lod_level == 0:
+        """Accumulate one sample: level i of a nested sequence contributes
+        its length to self.lod[i] as a cumulative offset (the reference's
+        LoD convention), and the leaves land flat in self.data. Iterative
+        level-order walk — each pass over `frontier` stamps one offset row
+        and descends one nesting level."""
+        if self.lod_level == 0:
             self.data.append(data)
-        else:
-            lod[0].append(lod[0][-1] + len(data))
-            for each_data in data:
-                self._feed_impl_(each_data, lod[1:], lod_level - 1)
+            return
+        frontier = [data]
+        for offsets in self.lod:
+            for seq in frontier:
+                offsets.append(offsets[-1] + len(seq))
+            frontier = [item for seq in frontier for item in seq]
+        self.data.extend(frontier)
 
     def done(self):
         if self.lod_level == 0:

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import dynamics as dynamics_mod
 from . import flags as flags_mod
 from . import memory as memory_mod
 from . import telemetry
@@ -846,7 +847,8 @@ class Executor:
                tuple(state_keys), self.place,
                getattr(program, "_amp_dtype", None),
                getattr(program, "_amp_level", "O1"),
-               program.random_seed, "window", steps, fetch_mode)
+               program.random_seed, "window", steps, fetch_mode,
+               dynamics_mod.cache_token(program))
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = self._compile_window(
@@ -924,9 +926,13 @@ class Executor:
         compiled.last_sig = sig
 
         # window succeeded: counter commit is atomic for all K steps
+        dyn_stats = new_state.pop(dynamics_mod.STATE_KEY, None)
         scope.set_var("__rng_counter__", rng_counter + steps)
         for n, v in new_state.items():
             scope.set_var(n, v)
+        if dyn_stats is not None:
+            dynamics_mod.on_window(program, prog_label, dyn_stats,
+                                   int(rng_counter), steps)
 
         telemetry.counter(
             "executor_runs_total", "Executor.run calls",
@@ -1176,7 +1182,8 @@ class Executor:
                    getattr(program, "_amp_level", "O1"),
                    # the seed folds into the compiled step (see _compile),
                    # so changing program.random_seed must recompile
-                   program.random_seed)
+                   program.random_seed,
+                   dynamics_mod.cache_token(program))
             compiled = self._cache.get(key) if use_program_cache else None
             if compiled is None:
                 compiled = self._compile(program, state_keys, sorted(feed_vals),
@@ -1218,6 +1225,10 @@ class Executor:
                     raise oom from e
                 raise
             run_dt = time.perf_counter() - run_t0
+            # the dynamics stats row leaves new_state immediately: its
+            # off-period NaN filler must never reach the check_nan scan or
+            # the scope writeback (recorded only after the step commits)
+            dyn_stats = new_state.pop(dynamics_mod.STATE_KEY, None)
             # compile-vs-execute split: XLA's own backend_compile events
             # (jax.monitoring) accumulated across the call — catches the
             # jit retraces the executor cache key cannot see
@@ -1317,6 +1328,7 @@ class Executor:
             run_dt = time.perf_counter() - run_t0
             compile_s = telemetry.jax_compile_seconds() - compile_before
             mode, donated, cache_status = "eager", 0, "n/a"
+            dyn_stats = None  # dynamics rides the traced step only
 
         if probe_sites:
             # pop the probe stat vectors (appended after the telemetry
@@ -1336,6 +1348,9 @@ class Executor:
         # the step is now known-good: commit the PRNG counter atomically
         # with (just before) the state write-back below
         scope.set_var("__rng_counter__", rng_counter + 1)
+        if dyn_stats is not None:
+            dynamics_mod.on_step(program, prog_label, dyn_stats,
+                                 int(rng_counter))
 
         telemetry.counter(
             "executor_runs_total", "Executor.run calls",
@@ -1709,7 +1724,7 @@ class Executor:
         ctx.env = prev_env
 
     def _trace_block(self, program, feed_vals, state_vals, fetch_names,
-                     persist_out, rng_key, lod_map):
+                     persist_out, rng_key, lod_map, grab_names=()):
         env: Dict[str, Any] = {}
         env.update(state_vals)
         env.update(feed_vals)
@@ -1788,7 +1803,11 @@ class Executor:
                             new_state[n + SEQLEN2_SUFFIX] = \
                                 env[n + SEQLEN2_SUFFIX]
                     break
-        return fetch, fetch_lens, new_state
+        # raw trace values the dynamics reduction reads (grad vars): no
+        # maybe_dense — SelectedRows grads reduce sparse — and no layout
+        # canonicalize, the stats are layout-invariant reductions
+        grabs = {n: env[n] for n in grab_names if n in env}
+        return fetch, fetch_lens, new_state, grabs
 
     def _make_step_fn(self, program, fetch_names, persist_out, lod_map):
         """The pure per-step function `fn(feed_vals, state_vals, rng_counter)
@@ -1798,6 +1817,7 @@ class Executor:
         mesh = getattr(program, "_mesh", None)
         param_specs = getattr(program, "_param_shardings", {})
         seed = program.random_seed or 12345
+        dyn_plan = dynamics_mod.plan(program)
 
         def _state_spec(n):
             # accumulators of ANY sharded parameter inherit its sharding
@@ -1816,9 +1836,15 @@ class Executor:
             # nothing host-side (eagerly it was ~3ms/step of tiny
             # dispatches, measurable against a ~100ms ResNet step)
             rng_key = jax.random.fold_in(jax.random.key(seed), rng_counter)
-            fetch, lens, new_state = self._trace_block(
+            fetch, lens, new_state, grabs = self._trace_block(
                 program, feed_vals, state_vals, fetch_names, persist_out,
-                rng_key, lod_map)
+                rng_key, lod_map,
+                grab_names=dyn_plan.grab_names if dyn_plan else ())
+            # fused dynamics reduction over pre-pin values (the stats are
+            # scalars; pinning them replicated below would be a no-op
+            # anyway, but the weights/grads must be the trace's own)
+            dyn_stats = dynamics_mod.sampled_stats(
+                dyn_plan, state_vals, new_state, grabs, rng_counter)
             if mesh is not None:
                 # pin state outputs to the same shardings the next run's
                 # in_shardings expect (annotated params keep their spec,
@@ -1847,6 +1873,10 @@ class Executor:
                     except (TypeError, ValueError):
                         pinned[n] = v
                 new_state = pinned
+            if dyn_stats is not None:
+                # rides new_state through the donated round-trip; the
+                # executor pops it before check_nan and scope writeback
+                new_state[dynamics_mod.STATE_KEY] = dyn_stats
             return fetch, lens, new_state
 
         return fn
@@ -2047,7 +2077,9 @@ class Executor:
                 fetch = [f[-1] for f in fetch_seq]
             new_state = dict(final_state)
             for n, v in extra_seq.items():
-                new_state[n] = v[-1]
+                # the dynamics stats row keeps its full [K, ...] stack —
+                # the observatory picks the period-boundary slices out
+                new_state[n] = v if n == dynamics_mod.STATE_KEY else v[-1]
             return fetch, new_state
 
         sh = self._shardings(program, state_names, feed_names, window=True)

@@ -589,6 +589,12 @@ def record_probes(exe, program, scope, sites, stat_vals, *, feed, new_state,
     audit = getattr(program, "_grad_audit", None)
     if audit is not None:
         audit._observe(stats, prog_label)
+    try:
+        # activation probes feed the observatory's `saturating` verdicts
+        from . import dynamics as dynamics_mod
+        dynamics_mod.observe_probes(prog_label, stats)
+    except Exception:
+        pass
     bad = sorted(((s, st) for s, st in stats.items()
                   if s.kind == "probe" and st.nonfinite),
                  key=lambda it: it[0].op_index)
@@ -644,8 +650,17 @@ class GradientAudit:
     instrument()/check_nan_inf when divergence should abort the step."""
 
     def __init__(self, program: Program, parameters=None,
-                 vanishing_threshold: float = 1e-8,
-                 exploding_threshold: float = 1e3):
+                 vanishing_threshold: Optional[float] = None,
+                 exploding_threshold: Optional[float] = None):
+        # thresholds default from the dynamics constants table so the
+        # audit and the observatory can never disagree on "vanishing"
+        from . import dynamics as dynamics_mod
+        if vanishing_threshold is None:
+            vanishing_threshold = \
+                dynamics_mod.THRESHOLDS["grad_vanishing_abs_mean"]
+        if exploding_threshold is None:
+            exploding_threshold = \
+                dynamics_mod.THRESHOLDS["grad_exploding_max_abs"]
         base = getattr(program, "_probe_parent", None) or program
         block = base.global_block()
         if parameters is None:
@@ -676,15 +691,12 @@ class GradientAudit:
         self._last: Dict[str, Dict[str, Any]] = {}
 
     def classify(self, st: TensorStats) -> str:
-        if st.nonfinite:
-            return "nonfinite"
-        if st.l2 == 0.0:
-            return "zero"
-        if st.abs_mean < self.vanishing_threshold:
-            return "vanishing"
-        if max(abs(st.min), abs(st.max)) > self.exploding_threshold:
-            return "exploding"
-        return "ok"
+        from . import dynamics as dynamics_mod
+        return dynamics_mod.classify_grad(
+            st.nonfinite, st.l2, st.abs_mean,
+            max(abs(st.min), abs(st.max)),
+            vanishing_threshold=self.vanishing_threshold,
+            exploding_threshold=self.exploding_threshold)
 
     def _observe(self, stats: Dict[ProbeSite, TensorStats], prog_label: str):
         for site, st in stats.items():
@@ -858,11 +870,18 @@ def dump_crash_report(path: Optional[str] = None, *, error=None,
         "events": telemetry.recent_events(200),
         "metrics": telemetry.registry().local_snapshot(),
         "program": None, "probe_stats": None, "grad_audit": None,
-        "memory": None,
+        "memory": None, "dynamics": None,
     }
     try:
         from . import memory as memory_mod
         report["memory"] = memory_mod.crash_section()
+    except Exception:
+        pass
+    try:
+        # last training-dynamics snapshot: per-series verdicts + final
+        # sample, so a crash report names the layer that died first
+        from . import dynamics as dynamics_mod
+        report["dynamics"] = dynamics_mod.crash_section()
     except Exception:
         pass
     if error is not None:
@@ -1078,6 +1097,17 @@ def format_crash_report(report: Dict[str, Any], *,
             detail = (f" l2={info['l2']:.4g}" if "l2" in info else
                       f" ({info.get('reason', '')})")
             lines.append(f"  {param}: {info.get('status')}{detail}")
+    dyn = report.get("dynamics") or {}
+    if dyn:
+        verd = dyn.get("verdicts") or []
+        lines.append(f"training dynamics: "
+                     f"{dyn.get('samples_recorded', 0)} samples, "
+                     f"{len(verd)} non-ok verdict(s)")
+        for v in verd[:10]:
+            since = (f" since step {v['since_step']}"
+                     if v.get("since_step") is not None else "")
+            lines.append(f"  {v.get('program')}/{v.get('series')} "
+                         f"[{v.get('role')}]: {v.get('code')}{since}")
     analysis = report.get("analysis") or {}
     if analysis:
         c = analysis.get("counts") or {}

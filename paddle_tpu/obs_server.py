@@ -16,6 +16,8 @@ foreground threads:
                ?n= limits, ?trace_id= filters, ?name= filters
     /alerts    run-sentinel alert ledger + hang state (sentinel.py)
     /report    roofline/fleet/SLO JSON roll-up
+    /dynamics  training-dynamics observatory: per-series health verdicts
+               + recent time-series (dynamics.py); ?n= limits rows
     /          endpoint index
 
 Enable with `PADDLE_TPU_OBS_PORT=<port>` (picked up at import via
@@ -216,10 +218,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, sentinel_mod.alerts_payload())
             elif route == "/report":
                 self._send_json(200, _report_payload())
+            elif route == "/dynamics":
+                from . import dynamics as dynamics_mod
+                n = q.get("n", [None])[0]
+                self._send_json(200, dynamics_mod.payload(
+                    recent=int(n) if n is not None else 32))
             elif route == "/":
                 self._send_json(200, {"endpoints": [
                     "/metrics", "/healthz", "/spans", "/alerts",
-                    "/report"]})
+                    "/report", "/dynamics"]})
             else:
                 self._send_json(404, {"error": f"no route {route}"})
         except BrokenPipeError:

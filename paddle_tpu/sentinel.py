@@ -102,6 +102,22 @@ ALERT_CATALOG = {
         "slo_burn_rate", "high", z=3.0, severity="page", reduce="max",
         label_filter={"window": "fast"}, min_value=1.0,
         help="a model's fast-window error-budget burn exceeded 1.0"),
+    # training-dynamics observatory (dynamics.py)
+    "dynamics_update_ratio_spike": _rule(
+        "dynamics_update_ratio", "high", z=4.0, severity="page",
+        reduce="max",
+        help="a series' |dW|/|W| update ratio spiked above its rolling "
+             "baseline (LR spike / divergence precursor)"),
+    "dynamics_dead_layer": _rule(
+        "dynamics_dead_layers", "high", z=4.0, severity="page",
+        reduce="max", min_value=1,
+        help="the observatory classified one or more series dead-layer "
+             "(grad rms ~ 0 across the verdict window)"),
+    "dynamics_frozen_param": _rule(
+        "dynamics_frozen_params", "high", z=4.0, severity="warn",
+        reduce="max", min_value=1,
+        help="the observatory classified one or more series frozen-param "
+             "(live grads, zero update ratio)"),
 }
 
 

@@ -891,4 +891,20 @@ METRIC_CATALOG = {
                                "hang-watchdog deadline expiries"),
     "train_loss": _m("gauge", ("program",),
                      "training loss observed by the run sentinel"),
+    # training-dynamics observatory (dynamics.py)
+    "dynamics_update_ratio": _m(
+        "gauge", ("program", "series"),
+        "per-series |dW|/(|W|+eps) from the fused on-device reduction"),
+    "dynamics_grad_rms": _m("gauge", ("program", "series"),
+                            "per-series gradient RMS"),
+    "dynamics_weight_rms": _m("gauge", ("program", "series"),
+                              "per-series parameter RMS"),
+    "dynamics_dead_layers": _m(
+        "gauge", ("program",), "series currently classified dead-layer"),
+    "dynamics_frozen_params": _m(
+        "gauge", ("program",), "series currently classified frozen-param"),
+    "dynamics_unhealthy_series": _m(
+        "gauge", ("program",), "series with any non-ok dynamics verdict"),
+    "dynamics_samples_total": _m(
+        "counter", ("program",), "dynamics samples recorded"),
 }

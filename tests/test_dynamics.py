@@ -1,0 +1,289 @@
+"""Training-dynamics observatory (ISSUE 19): the fused on-device
+parameter/gradient health reduction and its host-side verdict layer.
+
+The acceptance properties pinned here: turning the observatory on does
+not perturb training numerics AT ALL (bitwise parity of final weights,
+stats on vs off — the reduction is appended to the traced step, never
+inserted into it); the run_steps scan samples exactly one row per
+period boundary (no per-step host sync); the verdict layer classifies
+synthetic time-series into the stable health codes dashboards key on
+(dead-layer, frozen-param, exploding-update, nonfinite); GradientAudit's
+thresholds come from the SAME constants table (single source of truth,
+ISSUE 19 satellite); and /dynamics answers over real HTTP with the
+payload schema the CLI and dashboards consume."""
+
+import http.client
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dynamics, obs_server, telemetry
+from paddle_tpu import executor as executor_mod
+from paddle_tpu.framework import unique_name
+
+
+@pytest.fixture(autouse=True)
+def _fresh_dynamics_state():
+    telemetry.reset()
+    dynamics.reset()
+    yield
+    obs_server.stop()
+    telemetry.reset()
+    dynamics.reset()
+
+
+def _build_program(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h = fluid.layers.fc(input=x, size=8, act="relu")
+            pred = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            fluid.optimizer.Momentum(
+                learning_rate=0.01, momentum=0.9).minimize(
+                    loss, startup_program=startup)
+    return main, startup, loss
+
+
+def _batches(n, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        xb = rng.rand(batch, 4).astype(np.float32)
+        yb = (xb.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+        out.append({"x": xb, "y": yb})
+    return out
+
+
+def _param_names(main):
+    return sorted(p.name for p in main.global_block().all_parameters())
+
+
+def _train(steps, *, dyn_enabled, period=1):
+    """Fresh program + scope, `steps` per-step runs; -> {param: ndarray}."""
+    main, startup, loss = _build_program()
+    feeds = _batches(steps)
+    scope = executor_mod.Scope()
+    with dynamics.override(dyn_enabled, period):
+        with executor_mod.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for feed in feeds:
+                exe.run(main, feed=feed, fetch_list=[loss.name])
+            return {n: np.array(scope.find_var(n))
+                    for n in _param_names(main)}
+
+
+def test_bitwise_parity_stats_on_vs_off():
+    """The fused reduction reads the step's values; it must never feed
+    back into them. Same seed, same batches: final weights are bitwise
+    identical with the observatory off and sampling every step."""
+    base = _train(5, dyn_enabled=False)
+    dynamics.reset()
+    telemetry.reset()
+    observed = _train(5, dyn_enabled=True, period=1)
+    assert base.keys() == observed.keys()
+    for name in base:
+        assert np.array_equal(base[name], observed[name]), (
+            f"{name} diverged with dynamics enabled")
+    # and the observed run actually sampled (the parity is not vacuous)
+    assert dynamics.payload()["samples_recorded"] >= 5
+
+
+def test_per_step_sampling_respects_period():
+    """period=2: the startup run advances the counter to 1, so steps
+    commit counters 2..7 and exactly 2|counter samples land."""
+    with dynamics.override(True, 2):
+        main, startup, loss = _build_program()
+        scope = executor_mod.Scope()
+        with executor_mod.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for feed in _batches(6):
+                exe.run(main, feed=feed, fetch_list=[loss.name])
+    assert dynamics.payload()["samples_recorded"] == 3
+
+
+def test_run_steps_window_samples_period_boundaries():
+    """The scan stacks a [K, G, 8] row block on-device; the host unpack
+    must record exactly one sample per period boundary inside the
+    window — here counters 2..9 with period 4 hit 4 and 8."""
+    with dynamics.override(True, 4):
+        main, startup, loss = _build_program()
+        scope = executor_mod.Scope()
+        with executor_mod.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            exe.run_steps(main, feed_window=_batches(8),
+                          fetch_list=[loss.name])
+    assert dynamics.payload()["samples_recorded"] == 2
+    # both samples belong to every series' ring (one program, 4 params)
+    progs = dynamics.payload()["programs"]
+    assert len(progs) == 1
+    for series in next(iter(progs.values()))["series"].values():
+        assert series["samples"] == 2
+
+
+# -- verdict layer on synthetic series --------------------------------------
+
+
+def _plan_one(name="fc_0.w_0", role="ffn_up"):
+    ent = dynamics._ParamEntry(name, name + "@GRAD", False, [], role)
+    grp = dynamics._Group(name, role, [ent])
+    return dynamics.DynamicsPlan([grp], (ent.grad,), 1, 1)
+
+
+def _row(weight_l2=1.0, weight_rms=0.1, weight_max_abs=0.5, grad_l2=1.0,
+         grad_rms=0.1, grad_zero_frac=0.0, update_ratio=0.01,
+         moment_rms=-1.0):
+    vals = dict(weight_l2=weight_l2, weight_rms=weight_rms,
+                weight_max_abs=weight_max_abs, grad_l2=grad_l2,
+                grad_rms=grad_rms, grad_zero_frac=grad_zero_frac,
+                update_ratio=update_ratio, moment_rms=moment_rms)
+    return np.array([[vals[f] for f in dynamics.STAT_FIELDS]], np.float64)
+
+
+def _feed(plan, rows, prog="pX"):
+    for step, row in enumerate(rows):
+        dynamics._OBS.record(prog, step, plan, row)
+
+
+def _verdict_codes():
+    return {(v["program"], v["series"]): v["code"]
+            for v in dynamics.verdicts()}
+
+
+def test_dead_layer_verdict_and_gauge():
+    plan = _plan_one()
+    win = int(dynamics.THRESHOLDS["verdict_window"])
+    _feed(plan, [_row(grad_l2=0.0, grad_rms=0.0, update_ratio=0.0)] * win)
+    assert _verdict_codes() == {("pX", "fc_0.w_0"): "dead-layer"}
+    assert telemetry.read_gauge("dynamics_dead_layers", program="pX") == 1.0
+
+
+def test_frozen_param_needs_live_gradients():
+    """Zero updates with LIVE gradients is frozen-param (an optimizer
+    or lr problem), distinct from dead-layer (a gradient-flow one)."""
+    plan = _plan_one()
+    win = int(dynamics.THRESHOLDS["verdict_window"])
+    _feed(plan, [_row(grad_rms=0.1, update_ratio=0.0)] * win)
+    assert _verdict_codes() == {("pX", "fc_0.w_0"): "frozen-param"}
+    assert telemetry.read_gauge(
+        "dynamics_frozen_params", program="pX") == 1.0
+
+
+def test_exploding_update_vs_ewma_baseline():
+    """A ratio 50x the EWMA baseline (and above the absolute floor)
+    flips the verdict the LR-spike pager keys on; a steady ratio at the
+    baseline never does."""
+    plan = _plan_one()
+    _feed(plan, [_row(update_ratio=0.01)] * 8)
+    assert not dynamics.verdicts()
+    _feed(plan, [_row(update_ratio=0.5)])
+    assert _verdict_codes() == {("pX", "fc_0.w_0"): "exploding-update"}
+
+
+def test_nonfinite_wins_over_history():
+    plan = _plan_one()
+    win = int(dynamics.THRESHOLDS["verdict_window"])
+    _feed(plan, [_row(grad_rms=0.0, update_ratio=0.0)] * win)
+    _feed(plan, [_row(weight_l2=float("nan"))])
+    assert _verdict_codes() == {("pX", "fc_0.w_0"): "nonfinite"}
+
+
+def test_absent_optional_fields_round_trip_as_none():
+    """-1 is the on-device 'absent' sentinel for optional fields (no
+    grad this step, no optimizer moment); it must surface as null, not
+    a negative statistic."""
+    plan = _plan_one()
+    _feed(plan, [_row(grad_l2=-1.0, grad_rms=-1.0, grad_zero_frac=-1.0,
+                      update_ratio=-1.0, moment_rms=-1.0)])
+    series = dynamics.payload()["programs"]["pX"]["series"]["fc_0.w_0"]
+    last = series["last"]
+    for field in ("grad_l2", "grad_rms", "update_ratio", "moment_rms"):
+        assert last[field] is None
+    assert last["weight_l2"] == 1.0
+
+
+def test_jsonl_export(tmp_path, monkeypatch):
+    path = str(tmp_path / "dyn.jsonl")
+    monkeypatch.setenv("PADDLE_TPU_DYNAMICS_LOG", path)
+    plan = _plan_one()
+    _feed(plan, [_row()] * 2)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert len(recs) == 2
+    assert recs[0]["series"] == "fc_0.w_0"
+    assert recs[0]["code"] == "ok"
+    assert math.isclose(recs[1]["update_ratio"], 0.01)
+
+
+# -- threshold unification (GradientAudit satellite) ------------------------
+
+
+def test_gradient_audit_thresholds_come_from_dynamics_table():
+    """ISSUE 19 satellite: GradientAudit's band edges resolve from
+    dynamics.THRESHOLDS — one constants table, not two drifting ones."""
+    from paddle_tpu.inspector import GradientAudit
+
+    main, _, _ = _build_program()
+    audit = GradientAudit(main)
+    assert audit.vanishing_threshold == \
+        dynamics.THRESHOLDS["grad_vanishing_abs_mean"]
+    assert audit.exploding_threshold == \
+        dynamics.THRESHOLDS["grad_exploding_max_abs"]
+
+
+def test_gradient_audit_tracks_table_edits(monkeypatch):
+    """Editing the shared table moves a FRESH audit's bands — the
+    regression this pins is someone re-hardcoding the literals."""
+    from paddle_tpu.inspector import GradientAudit
+
+    main, _, _ = _build_program()
+    monkeypatch.setitem(dynamics.THRESHOLDS,
+                        "grad_vanishing_abs_mean", 3e-5)
+    assert GradientAudit(main).vanishing_threshold == 3e-5
+
+
+def test_classify_grad_bands():
+    cg = dynamics.classify_grad
+    assert cg(True, 1.0, 1.0, 1.0) == "nonfinite"
+    assert cg(False, 0.0, 0.0, 0.0) == "zero"
+    assert cg(False, 1e-9, 1e-9, 1e-9) == "vanishing"
+    assert cg(False, 1e4, 1.0, 1e4) == "exploding"
+    assert cg(False, 0.1, 0.05, 0.2) == "ok"
+    # explicit overrides (the audit's constructor args) still win
+    assert cg(False, 1e-3, 1e-3, 1e-3,
+              vanishing_threshold=1e-2) == "vanishing"
+
+
+# -- HTTP surface -----------------------------------------------------------
+
+
+def test_dynamics_endpoint_serves_payload():
+    plan = _plan_one()
+    win = int(dynamics.THRESHOLDS["verdict_window"])
+    _feed(plan, [_row(grad_l2=0.0, grad_rms=0.0, update_ratio=0.0)] * win)
+    srv = obs_server.start(port=0)
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    try:
+        conn.request("GET", "/dynamics?n=4")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        body = json.loads(resp.read())
+    finally:
+        conn.close()
+    assert body["enabled"] in (True, False)
+    assert body["samples_recorded"] == win
+    series = body["programs"]["pX"]["series"]["fc_0.w_0"]
+    assert series["verdict"] == "dead-layer"
+    assert len(series["recent"]) == 4
+    assert [v["code"] for v in body["verdicts"]] == ["dead-layer"]

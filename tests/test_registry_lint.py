@@ -501,3 +501,44 @@ def test_thread_lint_catches_daemon_and_join_drift(monkeypatch):
     problems = checker.check_thread_catalog()
     assert any("joined=True" in m and "no join site" in m
                for _, m in problems), problems
+
+
+def test_dynamics_rules_consistent():
+    """ISSUE 19 satellite: health codes emitted by dynamics._code sites
+    match HEALTH_CATALOG both ways, the dynamics_* METRIC_CATALOG slice
+    has no dead entries, and the observatory's sentinel rules exist and
+    watch cataloged dynamics_* families."""
+    problems = _load_checker().check_dynamics_rules()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_dynamics_lint_catches_uncataloged_code(monkeypatch):
+    """Deleting a health code from the catalog must surface its emit
+    site — verdict codes are a stable vocabulary, not ad-hoc strings."""
+    from paddle_tpu import dynamics
+
+    checker = _load_checker()
+    monkeypatch.delitem(dynamics.HEALTH_CATALOG, "dead-layer")
+    problems = checker.check_dynamics_rules()
+    assert any("dead-layer" in m and "HEALTH_CATALOG" in m
+               for _, m in problems), problems
+
+
+def test_dynamics_lint_catches_dead_catalog_metric(monkeypatch):
+    """A dynamics_* catalog entry nothing emits is stale documentation;
+    and dropping a gauge the sentinel rules watch orphans the pager."""
+    from paddle_tpu import telemetry
+
+    checker = _load_checker()
+    monkeypatch.setitem(
+        telemetry.METRIC_CATALOG, "dynamics_phantom_gauge",
+        telemetry.METRIC_CATALOG["dynamics_grad_rms"])
+    problems = checker.check_dynamics_rules()
+    assert any("dynamics_phantom_gauge" in m and "never emits" in m
+               for _, m in problems), problems
+
+    monkeypatch.delitem(telemetry.METRIC_CATALOG, "dynamics_phantom_gauge")
+    monkeypatch.delitem(telemetry.METRIC_CATALOG, "dynamics_dead_layers")
+    problems = checker.check_dynamics_rules()
+    assert any("dynamics_dead_layer" in w and "can never fire" in m
+               for w, m in problems), problems

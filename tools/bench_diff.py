@@ -45,7 +45,7 @@ from typing import Dict, List, Optional, Tuple
 LOWER_BETTER_MARKERS = (
     "p50_ms", "p99_ms", "latency", "_seconds", "seconds_", "wall_s",
     "shed_fraction", "miss", "eviction", "stall", "skew", "dropped",
-    "timeout", "error", "exposed",
+    "timeout", "error", "exposed", "overhead",
 )
 HIGHER_BETTER_MARKERS = (
     "value", "qps", "images_per_sec", "mfu", "tflops", "goodput",

@@ -763,6 +763,111 @@ def check_alert_rules():
     return problems
 
 
+def check_dynamics_rules():
+    """[(where, message), ...] — pin the training-dynamics observatory
+    (ISSUE 19 satellite) in both directions:
+
+    * every health code a classification site emits (literal argument to
+      dynamics._code(...)) exists in dynamics.HEALTH_CATALOG, and every
+      cataloged code has at least one emit site — a stable code the docs
+      and dashboards key on can't silently vanish or be minted ad hoc;
+    * every dynamics_* metric the observatory emits is in
+      telemetry.METRIC_CATALOG and vice versa (the catalog's dynamics_*
+      slice has no dead entries) — the emit-site/catalog match itself is
+      check_metric_names' job;
+    * the dynamics_* sentinel rules exist, watch cataloged dynamics_*
+      families, and every dynamics_* ALERT_CATALOG rule resolves — a
+      renamed gauge can't orphan the pager."""
+    import ast
+    import os
+
+    from paddle_tpu import dynamics, sentinel, telemetry
+
+    problems = []
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "paddle_tpu", "dynamics.py")
+    rel = os.path.join("paddle_tpu", "dynamics.py")
+    with open(path) as f:
+        tree = ast.parse(f.read())
+
+    emitted_codes = {}   # code -> first where
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        attr = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None)
+        if attr != "_code" or not node.args:
+            continue
+        first = node.args[0]
+        where = f"{rel}:{node.lineno}"
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            problems.append((
+                where, "_code() called with a non-literal health code — "
+                       "the catalog lint cannot pin it"))
+            continue
+        emitted_codes.setdefault(first.value, where)
+
+    for code, where in sorted(emitted_codes.items()):
+        if code not in dynamics.HEALTH_CATALOG:
+            problems.append((
+                where, f"health code '{code}' is not in "
+                       f"dynamics.HEALTH_CATALOG — add it or fix the "
+                       f"typo"))
+    for code in sorted(dynamics.HEALTH_CATALOG):
+        if code not in emitted_codes:
+            problems.append((
+                "dynamics.HEALTH_CATALOG",
+                f"'{code}' is cataloged but no _code() site in "
+                f"dynamics.py emits it — dead entry or renamed code"))
+
+    # dynamics_* metric slice, both directions (emitter literals in
+    # dynamics.py vs the METRIC_CATALOG dynamics_* entries)
+    emitted_metrics = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        attr = (fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None)
+        if attr not in ("counter", "gauge", "histogram") or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            emitted_metrics.add(first.value)
+    cataloged = {n for n in telemetry.METRIC_CATALOG
+                 if n.startswith("dynamics_")}
+    for n in sorted(emitted_metrics - cataloged):
+        problems.append((
+            rel, f"dynamics emits metric '{n}' with no dynamics_* "
+                 f"METRIC_CATALOG entry"))
+    for n in sorted(cataloged - emitted_metrics):
+        problems.append((
+            "telemetry.METRIC_CATALOG",
+            f"'{n}' is cataloged but dynamics.py never emits it — dead "
+            f"entry or renamed gauge"))
+
+    # the sentinel slice: the observatory's pager rules must exist and
+    # resolve against cataloged dynamics_* families
+    dyn_rules = {n: r for n, r in sentinel.ALERT_CATALOG.items()
+                 if n.startswith("dynamics_")}
+    for expect in ("dynamics_update_ratio_spike", "dynamics_dead_layer"):
+        if expect not in dyn_rules:
+            problems.append((
+                "sentinel.ALERT_CATALOG",
+                f"'{expect}' rule missing — the observatory has no pager "
+                f"for this failure mode"))
+    for name, rule in sorted(dyn_rules.items()):
+        if rule["metric"] not in cataloged:
+            problems.append((
+                f"sentinel.ALERT_CATALOG['{name}']",
+                f"watches '{rule['metric']}' which is not a cataloged "
+                f"dynamics_* family — the rule can never fire"))
+    return problems
+
+
 def check_thread_catalog():
     """[(where, message), ...] — pin analysis/threads.THREAD_CATALOG
     against the actual `threading.Thread`/`go()` creation sites in
@@ -815,8 +920,11 @@ def main():
     thrc = check_thread_catalog()
     for where, msg in thrc:
         print(f"{where}: {msg}")
+    dynp = check_dynamics_rules()
+    for where, msg in dynp:
+        print(f"{where}: {msg}")
     problems = problems + coll + jit + sparse + embc + pallas + inferp \
-        + servp + plroles + metrics + alerts + thrc
+        + servp + plroles + metrics + alerts + thrc + dynp
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")
