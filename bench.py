@@ -83,6 +83,9 @@ def _parse_steps_per_call(v):
 
 STEPS_PER_CALL = _parse_steps_per_call(
     os.environ.get("BENCH_STEPS_PER_CALL", "1"))
+# O2 = bf16 end-to-end; O3 = O2 + int8/fp8 quantized matmul/conv compute
+# (quant.py). An O3 line carries quant_hits/quant_fallbacks; the serving
+# family quantizes with BENCH_QUANT=int8|fp8 (ServingEngine(quantize=)).
 AMP_LEVEL = os.environ.get("BENCH_AMP_LEVEL", "O2")
 # per-chip bf16 peak for MFU reporting (v5e ~197 TF/s, v4 ~275, v5p ~459);
 # override with BENCH_PEAK_TFLOPS for other chips. The in-session
@@ -612,6 +615,18 @@ def _emit(payload, errors=()):
     if payload.get("value") is not None:
         payload.update(_perf_fields(probe))
     payload.update(_analyze_fields())
+    try:  # quantization scoreboard (ISSUE 20): only on runs that could
+        # quantize (O3 training or quantized serving), so older families'
+        # lines keep their schema. quant_fallbacks is the acceptance
+        # gate — a benched family must hit zero.
+        from paddle_tpu import telemetry as _tel
+        qh = _tel.read_series("quant_kernel_total")
+        qf = _tel.read_series("quant_fallback_total")
+        if AMP_LEVEL == "O3" or os.environ.get("BENCH_QUANT") or qh or qf:
+            payload.setdefault("quant_hits", int(sum(qh.values())))
+            payload.setdefault("quant_fallbacks", int(sum(qf.values())))
+    except Exception:
+        pass
     _PERF_STEP[0] = None
     _ANALYZE_PROG[0] = None
     print(json.dumps(payload))
@@ -822,6 +837,7 @@ def main_fc():
         "unit": "examples/sec",
         "vs_baseline": None,   # no reference-published MLP anchor
         "batch": bsz, "hidden": hid, "amp": AMP,
+        "amp_level": AMP_LEVEL if AMP else None,
         "steps_timed": done,
         "steps_per_call": k,
         "steps_per_call_mode": ("auto" if STEPS_PER_CALL == "auto"
@@ -1378,9 +1394,12 @@ def main_serving():
     exe = fluid.Executor(fluid.TPUPlace(0))
     with executor_mod.scope_guard(scope):
         exe.run(startup)
+    quantize = os.environ.get("BENCH_QUANT", "").strip() or None
+    if quantize and quantize.lower() in ("0", "off", "none", "f32"):
+        quantize = None
     engine = ServingEngine(main_prog, feed_names=feeds,
                            fetch_names=fetches, scope=scope,
-                           max_batch=max_batch)
+                           max_batch=max_batch, quantize=quantize)
     rng = np.random.default_rng(0)
     rows_choices = [1, 2, 3, max(1, max_batch // 4)]
 
@@ -1431,6 +1450,7 @@ def main_serving():
         "slo_burn_fast": slo_report["windows"]["fast"]["burn_rate"],
         "slo_burn_slow": slo_report["windows"]["slow"]["burn_rate"],
         "model": model, "clients": clients, "max_batch": max_batch,
+        "quant": quantize,
         "compile_cache": {"hits": engine.cache_hits,
                           "misses": engine.cache_misses},
         "densify_fallbacks": sum(densify.values()),

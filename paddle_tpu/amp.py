@@ -21,6 +21,7 @@ Usage:
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from .framework.framework import Program, default_main_program
@@ -37,17 +38,26 @@ def enable(program: Optional[Program] = None, dtype: str = "bfloat16",
     level="O1": matmul/conv compute in bf16, outputs restored to f32.
     level="O2": activations stay bf16 end-to-end (halves HBM traffic);
     norm statistics, losses, master weights and optimizer state stay f32.
+    level="O3": O2 plus quantized MXU compute — eligible matmul/conv
+    lowerings route through paddle_tpu/quant.py (int8 by default,
+    PADDLE_TPU_QUANT_MODE=fp8 to switch) with per-channel dynamic
+    scaling and counted per-reason fallbacks; PADDLE_TPU_QUANT=0 gates
+    the routing off entirely, restoring O2 numerics bitwise.
     """
-    assert level in ("O1", "O2"), level
+    assert level in ("O1", "O2", "O3"), level
     program = program or default_main_program()
     program._amp_dtype = dtype
     program._amp_level = level
+    program._quant_mode = (
+        os.environ.get("PADDLE_TPU_QUANT_MODE", "int8")
+        if level == "O3" else None)
     return program
 
 
 def disable(program: Optional[Program] = None):
     program = program or default_main_program()
     program._amp_dtype = None
+    program._quant_mode = None
     return program
 
 

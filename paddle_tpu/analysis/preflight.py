@@ -12,6 +12,10 @@ re-implementations:
              amp.decorate'd, since mxu_cast runs before the gate); when
              the first answer is "dtype" we probe again in bf16 so an
              AMP suggestion doesn't mask a channels problem behind it.
+  quant    — quant.gate_for_op over the quantizable ops' desc avals,
+             only when the program is decorated O3 (_quant_mode set):
+             which matmul/conv ops will count into quant_fallback_total
+             at trace time, per reason, with the one-line fix.
   sharding — `_param_shardings` specs against the mesh axis sizes; GSPMD
              requires every annotated dim divisible by the product of
              its axes, and an axis name the mesh lacks silently means
@@ -121,6 +125,89 @@ def _check_pallas_convs(pctx):
                   f"{n} more conv2d op(s) fall back for the same reason "
                   f"({reason}) — details suppressed after the first "
                   f"{per_reason[reason]}")
+
+
+def _quant_hint(reason, op_type, k):
+    return {
+        "disabled": "PADDLE_TPU_QUANT=0 is set — unset it (or =1) to "
+                    "re-enable the quantized path",
+        "mode": "this mode/op pair has no quantized kernel (fp8 needs "
+                "backend support and the quant conv is int8-only); use "
+                "PADDLE_TPU_QUANT_MODE=int8",
+        "rank": "the quantized matmul tiles 2-D operands only",
+        "dtype": "operands must reach the gate as bf16/f32 — integer or "
+                 "f64 matmuls never quantize",
+        "shape": f"contraction depth K={k} must be >= 32 and a multiple "
+                 f"of 8 to amortize the scale sweeps on the int8 MXU "
+                 f"tile; pad the feature dim",
+        "kernel": "the quantized conv rides the Pallas kernel suite — "
+                  "fix the pallas-conv-fallback diagnosis first and this "
+                  "clears too",
+        "error_bound": "the trace-time error estimate exceeds "
+                       "PADDLE_TPU_QUANT_TOL; raise the tolerance to "
+                       "accept the quantization noise",
+    }.get(reason, reason)
+
+
+def _check_quant(pctx):
+    """Dry-run quant.gate_for_op — the REAL eligibility gate, not a
+    re-implementation — over every quantizable op's desc avals, so an O3
+    program learns before compile which ops will count into
+    quant_fallback_total and why. Only runs when the program is actually
+    decorated O3 (program._quant_mode set): an O1/O2 program falling
+    back everywhere is the configured behavior, not a diagnosis."""
+    import jax.numpy as jnp
+
+    from .. import quant
+
+    qmode = getattr(pctx.program, "_quant_mode", None)
+    if not qmode:
+        return
+    block = pctx.block
+    slots = {"conv2d": ("Input", "Filter"),
+             "depthwise_conv2d": ("Input", "Filter")}
+    per_reason = {}
+    rollup = {}
+    for i, op in enumerate(pctx.ops):
+        if op.type not in quant.QUANT_OPS:
+            continue
+        xslot, yslot = slots.get(op.type, ("X", "Y"))
+        xn = (op.desc.input(xslot) or [None])[0]
+        yn = (op.desc.input(yslot) or [None])[0]
+        if not (xn and yn and block.desc.has_var(xn)
+                and block.desc.has_var(yn)):
+            continue
+        xv, yv = block.desc.var(xn), block.desc.var(yn)
+        if xv.shape is None or yv.shape is None:
+            continue
+        # mxu_cast runs before the gate: O3 operands arrive bf16
+        x = _Aval([_PROBE_BATCH if d == -1 else d for d in xv.shape],
+                  jnp.bfloat16)
+        y = _Aval(yv.shape, jnp.bfloat16)
+        try:
+            reason = quant.gate_for_op(
+                op.type, {xslot: [x], yslot: [y]},
+                dict(op.desc.attrs), qmode, nhwc=False)
+        except Exception:  # noqa: BLE001 - odd desc shapes: shapes pass
+            continue       # already diagnosed those
+        if reason is None:
+            continue
+        k = x.shape[-1] if op.type in ("mul", "matmul") else None
+        seen = per_reason.get(reason, 0)
+        if seen >= 4:
+            rollup[reason] = rollup.get(reason, 0) + 1
+            continue
+        per_reason[reason] = seen + 1
+        pctx.emit(
+            "warning", "quant-fallback",
+            f"{op.type} will keep the bf16 path under O3 (reason: "
+            f"{reason}) and count into quant_fallback_total",
+            op_index=i, var=xn, hint=_quant_hint(reason, op.type, k))
+    for reason, n in sorted(rollup.items()):
+        pctx.emit("warning", "quant-fallback",
+                  f"{n} more quantizable op(s) fall back for the same "
+                  f"reason ({reason}) — details suppressed after the "
+                  f"first {per_reason[reason]}")
 
 
 def _axis_factor(entry, axis_sizes):
@@ -386,6 +473,7 @@ def _check_planner(pctx):
 
 def run(pctx):
     _check_pallas_convs(pctx)
+    _check_quant(pctx)
     _check_shardings(pctx)
     _check_layout(pctx)
     _check_plans(pctx)

@@ -31,6 +31,7 @@ import numpy as np
 
 from . import dynamics as dynamics_mod
 from . import flags as flags_mod
+from . import quant as quant_mod
 from . import memory as memory_mod
 from . import telemetry
 from . import tracing as tracing_mod
@@ -208,6 +209,9 @@ class LoweringContext:
         # f32 after each MXU op; O2 keeps activations bf16 end-to-end.
         self.amp_dtype = getattr(program, "_amp_dtype", None)
         self.amp_level = getattr(program, "_amp_level", "O1")
+        # O3 quantization mode ("int8"/"fp8", amp.py) or None; read by
+        # the matmul/conv lowerings to route through quant.py
+        self.quant_mode = getattr(program, "_quant_mode", None)
         # live env of the block being traced; lowerings use it to read
         # sequence-length side channels (`<var>@SEQLEN`, see seq_len()).
         self.env: Dict[str, Any] = {}
@@ -848,7 +852,8 @@ class Executor:
                getattr(program, "_amp_dtype", None),
                getattr(program, "_amp_level", "O1"),
                program.random_seed, "window", steps, fetch_mode,
-               dynamics_mod.cache_token(program))
+               dynamics_mod.cache_token(program),
+               quant_mod.cache_token(program))
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = self._compile_window(
@@ -1183,7 +1188,8 @@ class Executor:
                    # the seed folds into the compiled step (see _compile),
                    # so changing program.random_seed must recompile
                    program.random_seed,
-                   dynamics_mod.cache_token(program))
+                   dynamics_mod.cache_token(program),
+                   quant_mod.cache_token(program))
             compiled = self._cache.get(key) if use_program_cache else None
             if compiled is None:
                 compiled = self._compile(program, state_keys, sorted(feed_vals),

@@ -101,14 +101,16 @@ def mxu_cast(ctx, *xs):
     Under level O2 the restore dtype is None even after casting: activations
     stay bf16 end-to-end (halving HBM traffic — the dominant cost on
     bandwidth-bound chips); norm/loss lowerings locally upcast where
-    statistics need f32.
+    statistics need f32. O3 is O2 on this axis (bf16 activations; the
+    quantized routing happens downstream of this cast in the matmul/conv
+    lowerings), so gating quantization off restores O2 numerics exactly.
     """
     amp = getattr(ctx, "amp_dtype", None)
     if not amp:
         return xs, None
     cd = jnp.dtype(amp)
     casted = tuple(x.astype(cd) if x.dtype == jnp.float32 else x for x in xs)
-    if getattr(ctx, "amp_level", "O1") == "O2":
+    if getattr(ctx, "amp_level", "O1") in ("O2", "O3"):
         return casted, None
     any_cast = any(c is not x for c, x in zip(casted, xs))
     return casted, (jnp.float32 if any_cast else None)

@@ -626,6 +626,12 @@ def _conv_stats_pallas(ctx, g: Group, env) -> bool:
     conv, bn, act = g.conv, g.bn, g.act
     if bn.attr("is_test", False) or not ctx.layout_opt:
         return False
+    if getattr(ctx, "quant_mode", None):
+        # O3: the member-by-member ladder runs instead, so the conv
+        # member reaches its quantized routing (the bf16 conv+stats
+        # kernel would silently skip quantization for fused convs); the
+        # bn+act epilogue still fuses through _bn_act_pallas
+        return False
     xname = _first(conv.desc.input("Input"))
     wname = _first(conv.desc.input("Filter"))
     if env.get(xname) is None or env.get(wname) is None:

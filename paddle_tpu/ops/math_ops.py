@@ -49,7 +49,19 @@ def _mul(ctx, op_, ins):
     xn = op_.attr("x_num_col_dims", 1)
     yn = op_.attr("y_num_col_dims", 1)
     (xf, yf), restore = mxu_cast(ctx, _flat2(x, xn), _flat2(y, yn))
-    out2d = jnp.matmul(xf, yf)
+    qmode = getattr(ctx, "quant_mode", None)
+    if qmode:
+        from .. import quant
+        reason = quant.ineligible_matmul(xf, yf, qmode)
+        if reason is None:
+            quant.count_hit(op_.type)
+            pre = quant.prequantized(ctx, op_.desc.inputs["Y"][0])
+            out2d = quant.qmatmul(xf, yf, qmode, pre=pre)
+        else:
+            quant.count_fallback(op_.type, reason)
+            out2d = jnp.matmul(xf, yf)
+    else:
+        out2d = jnp.matmul(xf, yf)
     if restore is not None:
         out2d = out2d.astype(restore)
     out_shape = x.shape[:xn] + y.shape[yn:]
@@ -76,7 +88,22 @@ def _matmul(ctx, op_, ins):
     if op_.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
     (x, y), restore = mxu_cast(ctx, x, y)
-    out = jnp.matmul(x, y)
+    qmode = getattr(ctx, "quant_mode", None)
+    if qmode:
+        from .. import quant
+        reason = quant.ineligible_matmul(x, y, qmode)
+        if reason is None:
+            quant.count_hit(op_.type)
+            # the admission cache stores Y in [K, N] orientation, so a
+            # transposed Y quantizes dynamically (prequantize skips it)
+            pre = None if op_.attr("transpose_Y", False) else \
+                quant.prequantized(ctx, op_.desc.inputs["Y"][0])
+            out = quant.qmatmul(x, y, qmode, pre=pre)
+        else:
+            quant.count_fallback(op_.type, reason)
+            out = jnp.matmul(x, y)
+    else:
+        out = jnp.matmul(x, y)
     if restore is not None:
         out = out.astype(restore)
     alpha = op_.attr("alpha", 1.0)
@@ -142,7 +169,7 @@ def _make_ew(fn):
         # re-materialize f32 tensors at every fc/conv bias add and forfeit
         # the halved HBM traffic. The cast is in-trace, so the bias grad
         # flows back to the f32 master copy through the astype vjp.
-        if getattr(ctx, "amp_level", "O1") == "O2" and \
+        if getattr(ctx, "amp_level", "O1") in ("O2", "O3") and \
                 x.dtype == jnp.bfloat16 and y.dtype == jnp.float32:
             y = y.astype(x.dtype)
         return {"Out": [fn(x, y)]}

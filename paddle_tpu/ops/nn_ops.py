@@ -286,6 +286,7 @@ def _conv2d(ctx, op_, ins):
     reason-labelled pallas_fallback_total counter."""
     from . import layout as layout_mod
     from . import pallas_conv
+    from .. import quant
     x = jnp.asarray(ins["Input"][0])
     w = jnp.asarray(ins["Filter"][0])
     s = _pair(op_.attr("strides", [1, 1]))
@@ -296,12 +297,27 @@ def _conv2d(ctx, op_, ins):
     (x, w), restore = mxu_cast(ctx, x, w)
     if not nhwc_in:
         x = jnp.transpose(x, (0, 2, 3, 1))
+    qmode = getattr(ctx, "quant_mode", None)
     reason = pallas_conv.ineligible(x, w, s, p, d, groups)
     if reason is None:
         pallas_conv.count_hit(op_.type)
-        out = pallas_conv.conv2d(x, w, s, p, d)
+        qreason = quant.ineligible_conv(x, w, s, p, d, groups, qmode) \
+            if qmode else None
+        if qmode and qreason is None:
+            quant.count_hit(op_.type)
+            fname = op_.desc.inputs["Filter"][0]
+            out = quant.qconv2d(x, w, s, p, d, qmode,
+                                pre=quant.prequantized(ctx, fname))
+        else:
+            if qmode:
+                quant.count_fallback(op_.type, qreason)
+            out = pallas_conv.conv2d(x, w, s, p, d)
     else:
         pallas_conv.count_fallback(op_.type, reason)
+        if qmode:
+            # the quant conv rides the Pallas kernel suite: no kernel,
+            # no quantization (ineligible_conv's "kernel" prerequisite)
+            quant.count_fallback(op_.type, "kernel")
         out = jax.lax.conv_general_dilated(
             x, jnp.transpose(w, (2, 3, 1, 0)),
             window_strides=s, padding=[(p[0], p[0]), (p[1], p[1])],

@@ -6,20 +6,36 @@ in-repo templates).
 
 Tiling: NHWC operands, bf16 on the MXU datapath with f32 VMEM
 accumulation (preferred_element_type), channels in 128-lane tiles. The
-grid walks one output row per step with an H block of size 1 — at block
-size 1 the BlockSpec index map addresses *rows*, so strided/dilated
-input-row selection (`oh*stride + kh*dilation`) happens in the index map
-and no halo exchange or revisit is needed. Inside the kernel the kw taps
-unroll as a Python loop of strided row slices feeding [W-ish, Ci] x
-[Ci, Co] MXU dots into an f32 accumulator that carries across the
-sequential (innermost) reduction dim of the grid:
+grid walks one output row per step with an H *input* block of size 1 —
+at block size 1 the BlockSpec index map addresses *rows*, so
+strided/dilated input-row selection (`oh*stride + kh*dilation`) happens
+in the index map and no halo exchange or revisit is needed. Inside the
+kernel the kw taps unroll as a Python loop of strided row slices feeding
+[W-ish, Ci] x [Ci, Co] MXU dots into an f32 accumulator that carries
+across the sequential (innermost) reduction dim of the grid:
 
-  forward      grid (N, OH, Co/128, KH*Ci/128), acc [OW, 128]
+  forward      grid (N, OH/BH, Co/128, KH*Ci/128 * BH), acc [BH, OW, 128]
   grad-filter  grid (KH, Ci/128, Co/128, N*OH), acc [KW, 128, 128]
   grad-input   = the forward kernel on the stride-dilated cotangent with
                  the spatially flipped filter and transposed-conv padding
                  (lo = (K-1)*d - p, hi = H - Hd + p), so one kernel body
                  serves both directions.
+
+BH is the multi-row pipelining factor (BENCH_r06's headroom spend): the
+filter tile is by far the heaviest HBM stream of the row-walk (for a
+3x3 C=128 ResNet block each output row re-reads KH*KW*Ci*Co filter
+bytes against one input row), so the reduction dim is extended by BH
+output rows with the row index *innermost*. Consecutive grid steps then
+keep the same filter block index and Pallas skips the copy — filter
+traffic divides by BH while the f32 accumulator grows to [BH, OW, 128]
+rows of VMEM, double-buffered input rows stream as before. BH is the
+largest of {8, 4, 2, 1} that divides OH and fits the VMEM row budget.
+
+`conv2d_q8` is the forward kernel on int8 operands (quant.py's O3
+routing): int8 x/w tiles, int32 VMEM accumulation, and the per-channel
+dequantization vector applied to the output row while it is still in
+VMEM — the MXU runs int8 dots at twice the bf16 rate, which is where
+the O3 images/sec over O2 comes from.
 
 `conv2d_stats` is the forward kernel with the Co tile as the *outermost*
 grid dim and per-channel sum/sum-of-squares carried in VMEM scratch: the
@@ -53,9 +69,9 @@ from .pallas_attention import _compiler_params, _dot, _interpret, _scratch
 
 __all__ = [
     "FALLBACK_REASONS", "KERNELS", "PALLAS_CONV", "bn_apply", "conv2d",
-    "conv2d_grad_filter", "conv2d_grad_input", "conv2d_stats",
-    "count_fallback", "count_hit", "ineligible", "suppress_counters",
-    "supports",
+    "conv2d_grad_filter", "conv2d_grad_input", "conv2d_q8",
+    "conv2d_stats", "count_fallback", "count_hit", "ineligible",
+    "suppress_counters", "supports",
 ]
 
 PALLAS_CONV = os.environ.get("PADDLE_TPU_PALLAS_CONV", "1") == "1"
@@ -179,24 +195,47 @@ def _taps(x_row, kw_n, dw, sw, ow):
                         (sw, 1))
 
 
-def _fwd_kernel(x_ref, w_ref, o_ref, acc, *, kw_n, dw, sw, ow, n_s):
-    """Grid (N, OH, Co/128, KH*Ci/128): one output row [OW, 128] per
-    (n, oh, co), reduction taps streamed innermost."""
+def _dot_i32(a, b, dims):
+    """int8 x int8 -> int32 MXU dot (the 2x-rate datapath)."""
+    return lax.dot_general(a, b, (dims, ((), ())),
+                           preferred_element_type=jnp.int32)
+
+
+def _fwd_kernel(x_ref, w_ref, *refs, kw_n, dw, sw, ow, n_s, bh):
+    """Grid (N, OH/BH, Co/128, KH*Ci/128 * BH): one output row [OW, 128]
+    per (n, oh, co), reduction taps streamed innermost with the H-block
+    row index `hb` cycling fastest — so the filter block index is
+    unchanged for BH consecutive steps and its copy is skipped (module
+    docstring). Quantized form (5 refs): int8 operands, int32
+    accumulator, per-channel dequant vector applied on the way out."""
     import jax.experimental.pallas as pl
-    ss = pl.program_id(3)
+    if len(refs) == 3:
+        dq_ref, o_ref, acc = refs
+    else:
+        (o_ref, acc), dq_ref = refs, None
+    ss2 = pl.program_id(3)
+    ss = ss2 // bh                 # reduction step: kh * n_ci + ci tile
+    hb = ss2 % bh                  # output row within the H block
 
     @pl.when(ss == 0)
     def _zero():
-        acc[...] = jnp.zeros_like(acc)
+        acc[pl.ds(hb, 1)] = jnp.zeros((1,) + acc.shape[1:], acc.dtype)
 
+    dot = _dot if acc.dtype == jnp.float32 else _dot_i32
     x_row = x_ref[0, 0]            # [Wp, 128] one padded input row
     wt = w_ref[0]                  # [KW, 128, 128] one kh tap
+    total = None
     for kw, xs in enumerate(_taps(x_row, kw_n, dw, sw, ow)):
-        acc[...] += _dot(xs, wt[kw], ((1,), (0,)))
+        t = dot(xs, wt[kw], ((1,), (0,)))
+        total = t if total is None else total + t
+    acc[pl.ds(hb, 1)] += total[None]
 
     @pl.when(ss == n_s - 1)
     def _finish():
-        o_ref[0, 0] = acc[...].astype(o_ref.dtype)
+        row = acc[pl.ds(hb, 1)]
+        if dq_ref is not None:
+            row = row.astype(jnp.float32) * dq_ref[...]
+        o_ref[0, pl.ds(hb, 1)] = row.astype(o_ref.dtype)
 
 
 def _fwd_stats_kernel(x_ref, w_ref, o_ref, sum_ref, sq_ref, acc, ssum, ssq,
@@ -282,12 +321,22 @@ def _bn_apply_kernel(x_ref, scale_ref, bias_ref, mean_ref, var_ref, *refs,
 
 # --- pallas_call wrappers -----------------------------------------------
 
+def _block_h(oh: int, ow: int) -> int:
+    """Pipelining factor: the largest H block that divides OH and keeps
+    the [BH, OW, 128] accumulator + the output block inside a ~3 MB
+    VMEM slice of the row budget (4+2 bytes per element, x2 pipeline)."""
+    return next(b for b in (8, 4, 2, 1) if oh % b == 0 and b * ow <= 4096)
+
+
 def _conv_call(x, w_hwio, strides, dilations, pads, out_dtype=None,
-               stats=False):
+               stats=False, dq=None):
     """Shared conv driver. `x` NHWC (unpadded), `w_hwio` [KH, KW, Ci, Co],
     `pads` explicit ((lo_h, hi_h), (lo_w, hi_w)) so the grad-input call
-    can pass the asymmetric transposed-conv padding."""
+    can pass the asymmetric transposed-conv padding. `dq` (f32 [1, Co])
+    selects the int8 form: int8 operands, int32 accumulation, dequant
+    on the output row in VMEM."""
     import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
     n, _, _, ci = x.shape
     kh, kw_n, _, co = w_hwio.shape
     sh, sw = strides
@@ -301,26 +350,37 @@ def _conv_call(x, w_hwio, strides, dilations, pads, out_dtype=None,
     out_dtype = out_dtype or x.dtype
 
     if not stats:
-        grid = (n, oh, co // _LANE, n_s)
+        bh = _block_h(oh, ow)
+        grid = (n, oh // bh, co // _LANE, n_s * bh)
         x_spec = pl.BlockSpec(
             (1, 1, wp, _LANE),
-            lambda nn, hh, cc, ss: (nn, hh * sh + (ss // n_ci) * dh, 0,
-                                    ss % n_ci))
+            lambda nn, hh, cc, ss: (
+                nn, (hh * bh + ss % bh) * sh + (ss // bh // n_ci) * dh, 0,
+                (ss // bh) % n_ci))
         w_spec = pl.BlockSpec(
             (1, kw_n, _LANE, _LANE),
-            lambda nn, hh, cc, ss: (ss // n_ci, 0, ss % n_ci, cc))
-        o_spec = pl.BlockSpec((1, 1, ow, _LANE),
+            lambda nn, hh, cc, ss: (ss // bh // n_ci, 0,
+                                    (ss // bh) % n_ci, cc))
+        o_spec = pl.BlockSpec((1, bh, ow, _LANE),
                               lambda nn, hh, cc, ss: (nn, hh, 0, cc))
+        in_specs = [x_spec, w_spec]
+        operands = [xp, w_hwio]
+        acc_dtype = jnp.float32
+        if dq is not None:
+            in_specs.append(pl.BlockSpec((1, _LANE),
+                                         lambda nn, hh, cc, ss: (0, cc)))
+            operands.append(dq)
+            acc_dtype = jnp.int32
         kernel = functools.partial(_fwd_kernel, kw_n=kw_n, dw=dw, sw=sw,
-                                   ow=ow, n_s=n_s)
+                                   ow=ow, n_s=n_s, bh=bh)
         return pl.pallas_call(
-            kernel, grid=grid, in_specs=[x_spec, w_spec], out_specs=o_spec,
+            kernel, grid=grid, in_specs=in_specs, out_specs=o_spec,
             out_shape=jax.ShapeDtypeStruct((n, oh, ow, co), out_dtype),
-            scratch_shapes=[_scratch((ow, _LANE))],
+            scratch_shapes=[pltpu.VMEM((bh, ow, _LANE), acc_dtype)],
             interpret=_interpret(),
             compiler_params=_compiler_params(
                 ("parallel", "parallel", "parallel", "arbitrary")),
-        )(xp, w_hwio)
+        )(*operands)
 
     grid = (co // _LANE, n, oh, n_s)
     x_spec = pl.BlockSpec(
@@ -366,6 +426,20 @@ def conv2d_stats(x, w, strides, paddings, dilations, out_dtype=None):
         x, jnp.transpose(w, (2, 3, 1, 0)), strides, dilations,
         ((ph, ph), (pw, pw)), out_dtype=out_dtype, stats=True)
     return y, csum.reshape(-1), csq.reshape(-1)
+
+
+def conv2d_q8(x, w, strides, paddings, dilations, dq, out_dtype=None):
+    """Quantized forward: x [N, H, W, Ci] int8, w [Co, Ci, KH, KW] int8,
+    dq f32 [Co] the combined activation*weight dequant scales
+    (quant.qconv2d builds them). int32 VMEM accumulation, dequantized to
+    `out_dtype` (default bf16) on the output row. Caller must have
+    passed quant.ineligible_conv — which requires the `ineligible` gate
+    here, so the bf16 grad kernels keep agreeing with the route."""
+    ph, pw = paddings
+    return _conv_call(x, jnp.transpose(w, (2, 3, 1, 0)), strides,
+                      dilations, ((ph, ph), (pw, pw)),
+                      out_dtype=out_dtype or jnp.bfloat16,
+                      dq=jnp.asarray(dq, jnp.float32).reshape(1, -1))
 
 
 def conv2d_grad_input(dout, w, x_hw, strides, paddings, dilations,

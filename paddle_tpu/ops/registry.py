@@ -210,7 +210,12 @@ def generic_grad_lower(ctx, op, ins):
         return flat
 
     primals = [fwd_ins[s][i] for s, i in diff_paths]
-    out_vals, vjp_fn = jax.vjp(fwd_fn, primals)
+    # the vjp re-traces the forward lowering, which would book a second
+    # quant hit/fallback sample for an op that already counted itself on
+    # the forward trace (pallas_conv call sites suppress their own)
+    from .. import quant
+    with quant.suppress_counters():
+        out_vals, vjp_fn = jax.vjp(fwd_fn, primals)
 
     # Cotangents matched to fwd_fn's actual flat output.
     cts = []

@@ -280,8 +280,32 @@ def program_cost(executor, program, feed_avals: Dict[str, Any],
     concrete shapes the ProgramDesc cannot provide (-1 batch dims)."""
     import jax
     from . import executor as executor_mod
+    from . import quant
 
     table: Dict[str, Dict[str, float]] = {}
+    qmode = getattr(program, "_quant_mode", None)
+
+    def _peak_factor(op, ins, attrs):
+        """2.0 when this instance routes through the int8/fp8 path (the
+        MXU's int8 peak is 2x its bf16 peak, so the compute roofline
+        doubles), else 1.0. Replays the lowering gate on the observed
+        avals; convs are probed in both layout interpretations because
+        the observer cannot see the trace-time layout tags — a shape
+        that gates in under either is counted quantized. Best-effort by
+        design: any gate error reads as the conservative 1.0."""
+        if not qmode or op.type not in quant.QUANT_OPS:
+            return 1.0
+        try:
+            if quant.gate_for_op(op.type, ins, attrs, qmode,
+                                 nhwc=True) is None:
+                return 2.0
+            if op.type in ("conv2d", "depthwise_conv2d") and \
+                    quant.gate_for_op(op.type, ins, attrs, qmode,
+                                      nhwc=False) is None:
+                return 2.0
+        except Exception:  # noqa: BLE001
+            pass
+        return 1.0
 
     def observe(op, ins, outs):
         try:
@@ -291,7 +315,11 @@ def program_cost(executor, program, feed_avals: Dict[str, Any],
         flops, bytes_ = op_cost(op.type, ins, outs, attrs)
         acc = table.setdefault(op.type,
                                {"flops": 0.0, "bytes": 0.0, "count": 0,
-                                "max_flops": 0.0, "shape": None})
+                                "max_flops": 0.0, "shape": None,
+                                "peak_factor": None})
+        factor = _peak_factor(op, ins, attrs)
+        acc["peak_factor"] = factor if acc["peak_factor"] is None \
+            else min(acc["peak_factor"], factor)
         acc["flops"] += flops
         acc["bytes"] += bytes_
         acc["count"] += 1
@@ -640,7 +668,13 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
                 for op_type, d in t["ops"].items():
                     acc = cost.setdefault(
                         op_type, {"flops": 0.0, "bytes": 0.0,
-                                  "max_flops": 0.0, "shape": None})
+                                  "max_flops": 0.0, "shape": None,
+                                  "peak_factor": None})
+                    pf = d.get("peak_factor")
+                    if pf is not None:
+                        acc["peak_factor"] = pf \
+                            if acc["peak_factor"] is None \
+                            else min(acc["peak_factor"], pf)
                     acc["flops"] += d["flops"]
                     acc["bytes"] += d["bytes"]
                     if d.get("max_flops", 0.0) >= acc["max_flops"]:
@@ -690,7 +724,14 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
         if c is not None and steps and ps:
             floors = []
             if flops and sustained:
-                floors.append(flops * steps / (sustained * 1e12))
+                # int8/fp8 roofline: an op whose every instance routes
+                # through the quantized path computes against the MXU's
+                # doubled low-precision peak, so its analytic floor
+                # halves (peak_factor from program_cost, min-combined
+                # across instances — one unquantized instance pins the
+                # whole op type to the bf16 roofline)
+                factor = c.get("peak_factor") or 1.0
+                floors.append(flops * steps / (sustained * factor * 1e12))
             if bytes_ and probes["hbm_gbps"]:
                 floors.append(bytes_ * steps / (probes["hbm_gbps"] * 1e9))
             if floors:
@@ -701,6 +742,8 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
                      "flops": flops, "bytes": bytes_, "tflops": tflops,
                      "intensity": intensity, "bound": bound,
                      "shape": c.get("shape") if c else None,
+                     "peak_factor": (c.get("peak_factor") or 1.0)
+                     if c else None,
                      "min_ps": min_ps, "efficiency": efficiency})
 
     wf = None
@@ -728,6 +771,12 @@ def collect_report(trace_dir, suppliers=(), steps: Optional[int] = None,
         "ridge_intensity": ridge, "nominal_tflops": nominal,
         "total_flops_per_step": total_flops if have_cost else None,
         "total_bytes_per_step": total_bytes if have_cost else None,
+        # fraction of the analytic flops that ride the int8/fp8 roofline
+        # (peak_factor 2.0 on every instance of the op type)
+        "quant_flops_fraction": (
+            sum(d["flops"] for d in cost.values()
+                if (d.get("peak_factor") or 1.0) > 1.0) / total_flops
+            if have_cost and total_flops else None),
         "hlo_counts": hlo if hlo["modules"] else None,
         "mfu_nominal": None, "mfu_vs_sustained": None, "notes": notes,
     }

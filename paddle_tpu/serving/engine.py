@@ -26,6 +26,12 @@ GradientMachine serving surface; here re-imagined TPU-natively).
 Requests with LoD inputs (sequence models through the C-API) fall back to
 the classic Executor.run path on the same pruned program — counted in
 `serving_fallback_total{reason=}`, never silently.
+
+`ServingEngine(..., quantize="int8")` serves the quantized program
+(quant.py): weights are pre-quantized once at admission and baked into
+the bucket executables as constants; activations get dynamic per-call
+scales in-trace. Ineligible ops/weights fall back per
+`quant_fallback_total{op,reason}` and serve at full precision.
 """
 
 from __future__ import annotations
@@ -106,7 +112,8 @@ class ServingEngine:
                  buckets: Optional[Sequence[int]] = None,
                  cache_capacity: Optional[int] = None,
                  emb_cache_budget_bytes: Optional[int] = None,
-                 emb_cache_tables: Optional[Dict[str, int]] = None):
+                 emb_cache_tables: Optional[Dict[str, int]] = None,
+                 quantize: Optional[str] = None):
         from .. import io as io_mod
         from ..executor import (Executor, Scope, TPUPlace, scope_guard,
                                 global_scope)
@@ -141,6 +148,30 @@ class ServingEngine:
         self.fetch_names = fetch_names
         self.program = program
         self._label = telemetry.program_label(program)
+
+        # Quantized serving (quant.py): mark the pruned program O3 so the
+        # serving trace routes eligible matmul/conv compute through int8
+        # (or fp8), then pre-quantize persistable weights ONCE here,
+        # host-side — the (q, scale) pairs bake into every bucket
+        # executable as constants, so per-call cost is only the dynamic
+        # activation scales inside the traced program. Ineligible weights
+        # are counted in quant_fallback_total and served unquantized.
+        self.quantize = quantize
+        self.quant_report: Optional[Dict[str, object]] = None
+        if quantize is not None:
+            from .. import quant as quant_mod
+            if quantize not in ("int8", "fp8"):
+                raise ValueError(
+                    f"quantize must be 'int8' or 'fp8', got {quantize!r}")
+            program._amp_dtype = "bfloat16"
+            program._amp_level = "O3"
+            program._quant_mode = quantize
+            self.quant_report = quant_mod.prequantize(
+                program, self._scope, quantize)
+            telemetry.log_event(
+                "serving_prequantize", program=self._label, mode=quantize,
+                quantized=len(self.quant_report["quantized"]),
+                skipped=len(self.quant_report["skipped"]))
 
         self._admit(program, feed_names, fetch_names)
 

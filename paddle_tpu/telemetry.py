@@ -757,6 +757,10 @@ METRIC_CATALOG = {
                               "pallas kernel launches"),
     "pallas_fallback_total": _m("counter", ("op", "reason"),
                                 "pallas kernels that fell back to XLA"),
+    "quant_kernel_total": _m("counter", ("op",),
+                             "ops routed through int8/fp8 quantization"),
+    "quant_fallback_total": _m("counter", ("op", "reason"),
+                               "quantizable ops kept at full precision"),
     "pallas_kernel_coverage": _m("gauge", (),
                                  "fraction of eligible ops on pallas"),
     "kernel_efficiency": _m("gauge", ("op", "shape"),

@@ -181,6 +181,39 @@ class TestPreflightPass:
         assert d.op_index is not None
         assert "multiple of 128" in (d.hint or "") and "Ci=64" in d.hint
 
+    def test_quant_preflight_flags_shallow_matmul(self):
+        """ISSUE 20 satellite: planted defect — a K=24 fc under O3
+        fails the shape gate (K < 32), and the preflight quant pass
+        says so before compile by dry-running quant.gate_for_op on the
+        desc avals; the K=64 layer downstream passes and stays quiet."""
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[24], dtype="float32")
+            h = fluid.layers.fc(input=x, size=64, act="relu")
+            out = fluid.layers.fc(input=h, size=64)
+        main._amp_dtype = "bfloat16"
+        main._amp_level = "O3"
+        main._quant_mode = "int8"
+        report = analyze_program(main, feeds=["x"], fetches=[out.name])
+        warns = _by_code(report, "quant-fallback")
+        assert len(warns) == 1, report.format(show_info=True)
+        assert not report.errors  # advisory, not fatal
+        d = warns[0]
+        assert "reason: shape" in d.message and d.op_index is not None
+        assert "K=24" in (d.hint or "")
+
+    def test_quant_preflight_silent_below_o3(self):
+        """The same shallow matmul without _quant_mode emits nothing:
+        an O1/O2 program falling back everywhere is configuration, not
+        a diagnosis."""
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[24], dtype="float32")
+            out = fluid.layers.fc(input=x, size=64)
+        main._amp_dtype = "bfloat16"
+        report = analyze_program(main, feeds=["x"], fetches=[out.name])
+        assert not _by_code(report, "quant-fallback")
+
     def test_emb_cache_thrash_warning(self):
         """ISSUE 14 satellite: a cache_rows request below the static
         per-step touched-row bound (batch x slots ids can all be

@@ -176,6 +176,35 @@ def test_history_groups_isolated_by_mode(tmp_path):
     assert bench_diff.main(["--history", path]) == 0
 
 
+def test_history_groups_isolated_by_precision_variant(tmp_path, capsys):
+    """An O3 (quantized) or int8-serving line is a different configuration,
+    not a regression of its f32 sibling — on XLA:CPU int8 is *slower* than
+    bf16, so without variant grouping every quantized line would gate red."""
+    path = _history(tmp_path, [
+        _entry("fc", 100.0, amp_level="O2"),
+        _entry("fc", 101.0, amp_level="O2"),
+        _entry("fc", 48.0, amp_level="O3"),      # half speed: OK, own group
+        _entry("fc", 49.0, amp_level="O3"),
+        _entry("serving", 50.0), _entry("serving", 51.0),
+        _entry("serving", 24.0, quant="int8"),
+        _entry("serving", 25.0, quant="int8"),
+    ])
+    assert bench_diff.main(["--history", path]) == 0
+    assert "4 groups compared" in capsys.readouterr().out
+    # ...but a real regression inside a variant group still gates
+    path = _history(tmp_path, [
+        _entry("fc", 48.0, amp_level="O3"),
+        _entry("fc", 30.0, amp_level="O3"),
+    ])
+    assert bench_diff.main(["--history", path]) == 1
+    assert "REGRESSION fc[O3]/fc value" in capsys.readouterr().out
+
+
+def test_history_quant_fallbacks_lower_better():
+    assert bench_diff.direction("quant_fallbacks") == "lower"
+    assert bench_diff.direction("quant_hits") == "higher"
+
+
 def test_history_meta_keys_not_compared(tmp_path):
     rows = [_entry("fc", 100.0), _entry("fc", 100.0)]
     rows[-1]["ts"] = 9_999.0          # wildly different timestamp

@@ -109,6 +109,72 @@ def test_pallas_lint_catches_missing_grad(monkeypatch):
     assert any("geometry" in m for _, m in problems), problems
 
 
+def test_quant_table_consistent():
+    """ISSUE 20 satellite: quant.QUANT_OPS must agree with the op
+    registry, the lowering sources (each table entry's lowering consults
+    the quant gate — directly or one delegation deep) and
+    quant.FALLBACK_REASONS. A gap doesn't raise: the op just silently
+    serves at full precision under O3, or a fallback reason ships as an
+    unlabelled counter series."""
+    problems = _load_checker().check_quant_table()
+    assert not problems, "; ".join(f"{w}: {m}" for w, m in problems)
+
+
+def test_quant_table_nonempty():
+    """The lint is vacuous if an import regression empties the table."""
+    from paddle_tpu import quant
+
+    assert quant.QUANT_OPS and quant.FALLBACK_REASONS
+    assert {"mul", "matmul", "conv2d"} <= set(quant.QUANT_OPS)
+
+
+def test_quant_lint_catches_defects(monkeypatch):
+    """Sanity, all four directions: an unregistered table entry, a table
+    entry whose lowering never routes through quant, a bogus entry-point
+    name, and a declared-but-never-produced fallback reason."""
+    from paddle_tpu import quant
+
+    checker = _load_checker()
+    orig = quant.QUANT_OPS
+
+    monkeypatch.setattr(quant, "QUANT_OPS",
+                        {**orig, "phantom_matmul": "qmatmul"})
+    problems = checker.check_quant_table()
+    assert any("phantom_matmul" in m and "not registered" in m
+               for _, m in problems), problems
+
+    # relu is registered but its lowering never consults the quant gate
+    monkeypatch.setattr(quant, "QUANT_OPS", {**orig, "relu": "qmatmul"})
+    problems = checker.check_quant_table()
+    assert any("relu" in m and "never consults" in m
+               for _, m in problems), problems
+
+    monkeypatch.setattr(quant, "QUANT_OPS", {**orig, "mul": "qphantom"})
+    problems = checker.check_quant_table()
+    assert any("qphantom" in m for _, m in problems), problems
+
+    monkeypatch.setattr(quant, "QUANT_OPS", orig)
+    monkeypatch.setattr(quant, "FALLBACK_REASONS",
+                        quant.FALLBACK_REASONS | {"phase_of_moon"})
+    problems = checker.check_quant_table()
+    assert any("phase_of_moon" in m and "never produced" in m
+               for _, m in problems), problems
+
+
+def test_quant_lint_catches_missing_table_entry(monkeypatch):
+    """Converse direction: a lowering that routes through quant whose op
+    type is dropped from QUANT_OPS (prequantize/preflight/roofline
+    would be blind to it)."""
+    from paddle_tpu import quant
+
+    checker = _load_checker()
+    trimmed = {k: v for k, v in quant.QUANT_OPS.items() if k != "matmul"}
+    monkeypatch.setattr(quant, "QUANT_OPS", trimmed)
+    problems = checker.check_quant_table()
+    assert any("'matmul'" in m and "not" in m and "QUANT_OPS" in m
+               for _, m in problems), problems
+
+
 def test_infer_rules_cover_registry():
     """ISSUE 12 satellite: every registered op resolves to exactly one
     shape-rule source in analysis/infer.py (checker, registry

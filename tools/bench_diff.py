@@ -14,7 +14,9 @@ eyeballing two JSON files. This tool is the gate:
 
 `--history` (ISSUE 17 satellite) gates the standing ledger bench.py
 appends to instead of two hand-picked files: entries are grouped by
-(mode, family), and within each group the NEWEST entry is compared
+(mode, family) plus precision variant (amp_level / quant, so an O3 or
+int8 line never gates against its f32 sibling), and within each group
+the NEWEST entry is compared
 against the per-key rolling MEDIAN of all prior entries with the same
 direction-aware thresholds — the standing regression gate the BENCH_r*
 campaign runs after every round. Groups with fewer than two entries are
@@ -45,7 +47,7 @@ from typing import Dict, List, Optional, Tuple
 LOWER_BETTER_MARKERS = (
     "p50_ms", "p99_ms", "latency", "_seconds", "seconds_", "wall_s",
     "shed_fraction", "miss", "eviction", "stall", "skew", "dropped",
-    "timeout", "error", "exposed", "overhead",
+    "timeout", "error", "exposed", "overhead", "fallback",
 )
 HIGHER_BETTER_MARKERS = (
     "value", "qps", "images_per_sec", "mfu", "tflops", "goodput",
@@ -112,7 +114,7 @@ def diff(old: Dict, new: Dict, threshold: float = 0.05) \
 # ledger metadata stamped by bench._append_history (or non-numeric):
 # excluded from comparison so a sha change is not a "regression"
 _HISTORY_META_KEYS = {"ts", "git_sha", "mode", "family", "metric",
-                      "unit", "errors"}
+                      "unit", "errors", "amp_level", "quant"}
 
 
 def _median(vals: List[float]) -> float:
@@ -121,15 +123,29 @@ def _median(vals: List[float]) -> float:
     return vals[k] if len(vals) % 2 else 0.5 * (vals[k - 1] + vals[k])
 
 
+def _variant(e: Dict) -> str:
+    """Precision-variant tag for grouping: an O3/int8 line is a different
+    configuration, not a regression of the O2/f32 line it rides next to
+    in the ledger (XLA:CPU int8 matmuls are *slower* than bf16, so mixing
+    them in one group would flag every quantized run)."""
+    tags = [str(t) for t in (e.get("amp_level"), e.get("quant")) if t]
+    return "+".join(tags)
+
+
 def history_diff(entries: List[Dict], threshold: float = 0.05) \
         -> Tuple[List[Dict], List[Tuple[str, str, int]]]:
-    """-> (regressions, groups). Newest entry per (mode, family) vs the
-    per-key median of that group's prior entries, direction-aware. Each
-    regression entry adds 'group'; `groups` lists (mode, family, n) for
-    every group seen (n < 2 means skipped)."""
+    """-> (regressions, groups). Newest entry per (mode, family,
+    precision-variant) vs the per-key median of that group's prior
+    entries, direction-aware. Each regression entry adds 'group';
+    `groups` lists (mode, family, n) for every group seen (n < 2 means
+    skipped)."""
     by_group: Dict[Tuple[str, str], List[Dict]] = {}
     for e in entries:
-        key = (str(e.get("mode", "?")), str(e.get("family", "?")))
+        mode = str(e.get("mode", "?"))
+        tag = _variant(e)
+        if tag:
+            mode = f"{mode}[{tag}]"
+        key = (mode, str(e.get("family", "?")))
         by_group.setdefault(key, []).append(e)
 
     regressions: List[Dict] = []
@@ -211,8 +227,8 @@ def main(argv=None) -> int:
     ap.add_argument("new", nargs="?", help="candidate BENCH json")
     ap.add_argument("--history", default=None,
                     help="BENCH_HISTORY.jsonl ledger: compare the newest "
-                         "entry per (mode, family) against the median of "
-                         "prior entries")
+                         "entry per (mode, family, precision variant) "
+                         "against the median of prior entries")
     ap.add_argument("--threshold", type=float, default=0.05,
                     help="relative regression tolerance (default 0.05 "
                          "= 5%%)")

@@ -30,6 +30,15 @@ same way: orphan kernels, conv window kinds without a dispatch entry,
 forward kernels missing their grad twin (the shared-gate/vjp contract),
 and fallback reasons the gate produces but FALLBACK_REASONS omits.
 
+The quant-table lint (ISSUE 20 satellite) pins quant.QUANT_OPS the same
+way, both directions: every quantizable op must be registered AND its
+lowering must consult the quant gate (else the op silently loses
+quantization under O3), every lowering that routes through quant must be
+in the table (else prequantize/preflight/roofline don't know it exists),
+and the gates' produced fallback reasons must match FALLBACK_REASONS
+exactly (an undeclared reason is an unlabelled quant_fallback_total
+series; a declared-but-never-produced one is a dead counter label).
+
 The infer-rules lint (ISSUE 12 satellite) pins the static analyzer's
 shape-pass coverage: every registered op must resolve to exactly one
 rule source (a hand-written analysis CHECKER, the registry's own
@@ -331,6 +340,90 @@ def check_pallas_table():
         problems.append((
             "pallas_conv.FALLBACK_REASONS",
             f"declared reason '{reason}' is never produced by the gate — "
+            f"dead counter label"))
+    return problems
+
+
+def check_quant_table():
+    """[(where, message), ...] — pin quant.QUANT_OPS (ISSUE 20) against
+    ops/registry.py, the lowering sources, and the fallback-reason
+    vocabulary, both directions (module docstring lists the silent
+    failure modes). A lowering "consults the gate" when its source (or,
+    one delegation deep, a `_name(ctx, op_, ins)` callee's source —
+    depthwise_conv2d delegates to _conv2d) references the quant routing
+    surface: ineligible_* / qmatmul / qconv2d."""
+    import inspect
+    import re
+
+    from paddle_tpu import quant
+    from paddle_tpu.ops import registry
+
+    _ROUTE = re.compile(r"quant\.(ineligible_matmul|ineligible_conv|"
+                        r"qmatmul|qconv2d)\(")
+
+    def _consults_gate(fn, depth=1):
+        try:
+            src = inspect.getsource(fn)
+        except (OSError, TypeError):
+            return False
+        if _ROUTE.search(src):
+            return True
+        if depth <= 0:
+            return False
+        mod = inspect.getmodule(fn)
+        return any(
+            callable(getattr(mod, callee, None)) and
+            _consults_gate(getattr(mod, callee), depth - 1)
+            for callee in re.findall(r"\b(_[a-z0-9_]+)\(ctx, op_, ins\)",
+                                     src))
+
+    problems = []
+    registered = set(registry.registered_ops())
+    for op_type, entry in sorted(quant.QUANT_OPS.items()):
+        if op_type not in registered:
+            problems.append((
+                "quant.QUANT_OPS",
+                f"'{op_type}' is quantizable but not registered in "
+                f"ops/registry.py — the route can never run"))
+            continue
+        if not callable(getattr(quant, entry, None)):
+            problems.append((
+                "quant.QUANT_OPS",
+                f"'{op_type}' names entry point '{entry}' which is not "
+                f"a callable in quant.py"))
+        lower = registry.get(op_type).lower
+        if lower is None or not _consults_gate(lower):
+            problems.append((
+                "quant.QUANT_OPS",
+                f"'{op_type}' lowering never consults the quant gate — "
+                f"the op silently loses quantization under O3"))
+    for op_type in sorted(registered - set(quant.QUANT_OPS)):
+        lower = registry.get(op_type).lower
+        if lower is None:
+            continue
+        try:
+            src = inspect.getsource(lower)
+        except (OSError, TypeError):
+            continue
+        if _ROUTE.search(src):
+            problems.append((
+                "quant.QUANT_OPS",
+                f"'{op_type}' lowering routes through quant but is not "
+                f"in QUANT_OPS — prequantize/preflight/roofline are "
+                f"blind to it"))
+    produced = set()
+    for gate in (quant.ineligible_matmul, quant.ineligible_conv):
+        produced |= set(re.findall(r'return "([a-z_]+)"',
+                                   inspect.getsource(gate)))
+    for reason in sorted(produced - quant.FALLBACK_REASONS):
+        problems.append((
+            "quant.FALLBACK_REASONS",
+            f"a gate returns '{reason}' but it is not declared — an "
+            f"unlabelled quant_fallback_total series"))
+    for reason in sorted(quant.FALLBACK_REASONS - produced):
+        problems.append((
+            "quant.FALLBACK_REASONS",
+            f"declared reason '{reason}' is never produced by a gate — "
             f"dead counter label"))
     return problems
 
@@ -902,6 +995,9 @@ def main():
     pallas = check_pallas_table()
     for where, msg in pallas:
         print(f"{where}: {msg}")
+    quantp = check_quant_table()
+    for where, msg in quantp:
+        print(f"{where}: {msg}")
     inferp = check_infer_rules()
     for where, msg in inferp:
         print(f"{where}: {msg}")
@@ -923,8 +1019,8 @@ def main():
     dynp = check_dynamics_rules()
     for where, msg in dynp:
         print(f"{where}: {msg}")
-    problems = problems + coll + jit + sparse + embc + pallas + inferp \
-        + servp + plroles + metrics + alerts + thrc + dynp
+    problems = problems + coll + jit + sparse + embc + pallas + quantp \
+        + inferp + servp + plroles + metrics + alerts + thrc + dynp
     if problems:
         print(f"{len(problems)} lint problem"
               f"{'' if len(problems) == 1 else 's'}")
